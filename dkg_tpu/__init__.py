@@ -1,0 +1,19 @@
+"""dkg_tpu — a TPU-native distributed key generation (DKG) framework.
+
+A from-scratch JAX/XLA implementation of the Gennaro-Jarecki-Krawczyk-Rabin
+DKG with hybrid-encrypted share delivery (capability parity with the
+reference Rust crate `dkg`, see SURVEY.md), redesigned TPU-first:
+
+* field/curve arithmetic as batched 16-bit-limb uint32 tensor ops
+  (``dkg_tpu.fields``, ``dkg_tpu.groups``);
+* per-party protocol loops turned into whole-committee batched kernels
+  (``dkg_tpu.ops``);
+* crypto building blocks — Pedersen commitments, lifted/hybrid ElGamal,
+  DLEQ NIZKs (``dkg_tpu.crypto``);
+* the five-round protocol state machine (``dkg_tpu.dkg``);
+* participant-axis sharding over a device mesh (``dkg_tpu.parallel``).
+"""
+
+from dkg_tpu import fields, groups  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,28 @@
+from . import device, host
+from .spec import (
+    ALL_FIELDS,
+    BLS12_381_P,
+    BLS12_381_R,
+    L25519,
+    P25519,
+    SECP256K1_N,
+    SECP256K1_P,
+    FieldSpec,
+    int_to_limbs,
+    limbs_to_int,
+)
+
+__all__ = [
+    "ALL_FIELDS",
+    "BLS12_381_P",
+    "BLS12_381_R",
+    "L25519",
+    "P25519",
+    "SECP256K1_N",
+    "SECP256K1_P",
+    "FieldSpec",
+    "device",
+    "host",
+    "int_to_limbs",
+    "limbs_to_int",
+]

@@ -1,0 +1,263 @@
+"""Batched modular arithmetic on 16-bit limb arrays (JAX / XLA, TPU-first).
+
+Representation: a field element is ``L`` little-endian 16-bit limbs held in
+a ``uint32`` array of shape ``(..., L)``; every operation is batched over
+the leading axes.  This is the device-side replacement for the scalar
+field/group arithmetic the reference gets from ``curve25519-dalek``
+(reference: src/traits.rs:142-238, src/groups.rs:11-90) — but batched: the
+DKG protocol's hot loops are per-party/per-coefficient scalar ops
+(reference: src/dkg/committee.rs:151-186, :292-296), which here become one
+wide array op over all parties at once.
+
+TPU constraints honoured:
+
+* no 64-bit integer ops — all products are 16x16->32 in ``uint32`` lanes;
+* no data-dependent control flow — carries/borrows via ``lax.scan`` over
+  the (static-length) limb axis, conditionals via branchless selects;
+* reduction is Barrett with compile-time constants (see spec.py).
+
+Overflow discipline (the invariants that make this correct):
+
+* normalized limbs are < 2**16, stored in uint32;
+* schoolbook product columns accumulate <= 2*L terms of < 2**16 each
+  (after hi/lo split), so columns are < 2**21 for L<=24 — safely inside
+  uint32 for the carry scan;
+* Barrett remainder fits in L+1 limbs because r < 3p < b**(L+1).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .spec import FieldSpec
+
+MASK16 = jnp.uint32(0xFFFF)
+
+
+def _u32(x) -> jax.Array:
+    return jnp.asarray(x, dtype=jnp.uint32)
+
+
+# ---------------------------------------------------------------------------
+# carry / borrow primitives
+# ---------------------------------------------------------------------------
+
+
+def normalize(cols: jax.Array, out_len: int) -> jax.Array:
+    """Carry-propagate accumulator columns into ``out_len`` 16-bit limbs.
+
+    ``cols`` may hold values up to ``2**32 - 2**16`` per column (the scan
+    adds an incoming carry of < 2**16, which must not wrap uint32); the
+    result is taken mod ``2**(16*out_len)`` (truncation is intentional —
+    callers use it for "mod b**k" semantics).
+    """
+    cols = _u32(cols)
+    k = cols.shape[-1]
+    if k < out_len:
+        pad = [(0, 0)] * (cols.ndim - 1) + [(0, out_len - k)]
+        cols = jnp.pad(cols, pad)
+    xs = jnp.moveaxis(cols[..., :out_len], -1, 0)
+
+    def step(carry, col):
+        s = col + carry
+        return s >> 16, s & MASK16
+
+    _, limbs = lax.scan(step, jnp.zeros(cols.shape[:-1], jnp.uint32), xs)
+    return jnp.moveaxis(limbs, 0, -1)
+
+
+def sub_with_borrow(a: jax.Array, b: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """(a - b) mod 2**(16K) plus the final borrow flag (1 iff a < b).
+
+    Both inputs must be normalized limb arrays of equal last-dim K.
+    """
+    a, b = jnp.broadcast_arrays(_u32(a), _u32(b))
+    xs = (jnp.moveaxis(a, -1, 0), jnp.moveaxis(b, -1, 0))
+
+    def step(borrow, ab):
+        ai, bi = ab
+        s = ai - bi - borrow  # uint32 wraparound encodes the sign
+        return s >> 31, s & MASK16
+
+    borrow, limbs = lax.scan(step, jnp.zeros(a.shape[:-1], jnp.uint32), xs)
+    return jnp.moveaxis(limbs, 0, -1), borrow
+
+
+def cond_sub(x: jax.Array, m) -> jax.Array:
+    """Branchless ``x - m if x >= m else x`` on equal-length limb arrays."""
+    m = _u32(m)
+    d, borrow = sub_with_borrow(x, jnp.broadcast_to(m, x.shape))
+    return jnp.where((borrow != 0)[..., None], x, d)
+
+
+# ---------------------------------------------------------------------------
+# wide multiply
+# ---------------------------------------------------------------------------
+
+
+def mul_wide(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Full product of limb arrays: (..., La) x (..., Lb) -> (..., La+Lb).
+
+    Schoolbook outer product with hi/lo 16-bit split so every column sum
+    stays inside uint32, then one carry scan.  This is the workhorse under
+    every field multiply; XLA fuses the slice-adds into the surrounding
+    elementwise graph.
+    """
+    a, b = _u32(a), _u32(b)
+    la, lb = a.shape[-1], b.shape[-1]
+    prod = a[..., :, None] * b[..., None, :]  # 16x16 -> 32, exact in uint32
+    lo = prod & MASK16
+    hi = prod >> 16
+    batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    out = jnp.zeros(batch + (la + lb,), jnp.uint32)
+    for i in range(la):
+        out = out.at[..., i : i + lb].add(lo[..., i, :])
+        out = out.at[..., i + 1 : i + 1 + lb].add(hi[..., i, :])
+    return normalize(out, la + lb)
+
+
+# ---------------------------------------------------------------------------
+# Barrett reduction and the modular ops
+# ---------------------------------------------------------------------------
+
+
+def barrett_reduce(fs: FieldSpec, x: jax.Array) -> jax.Array:
+    """Reduce a normalized 2L-limb value < b**(2L) to L limbs mod p.
+
+    Classic Barrett (HAC Alg. 14.42) with base b = 2**16: the quotient
+    estimate is off by at most 2, fixed by two branchless conditional
+    subtractions.
+    """
+    L = fs.limbs
+    mu = _u32(fs.barrett_mu)  # (L+1,)
+    p_ext = _u32(fs.p_limbs_ext)  # (L+1,)
+    q1 = x[..., L - 1 :]  # floor(x / b**(L-1)), L+1 limbs
+    q2 = mul_wide(q1, mu)
+    q3 = q2[..., L + 1 :]  # floor(q1*mu / b**(L+1)), L+1 limbs
+    r1 = x[..., : L + 1]  # x mod b**(L+1)
+    r2 = mul_wide(q3, p_ext)[..., : L + 1]  # q3*p mod b**(L+1)
+    r, _ = sub_with_borrow(r1, r2)  # wraparound == +b**(L+1): r in [0, 3p)
+    r = cond_sub(r, p_ext)
+    r = cond_sub(r, p_ext)
+    return r[..., :L]
+
+
+def zeros(fs: FieldSpec, batch: tuple = ()) -> jax.Array:
+    return jnp.zeros(batch + (fs.limbs,), jnp.uint32)
+
+
+def ones(fs: FieldSpec, batch: tuple = ()) -> jax.Array:
+    return jnp.broadcast_to(
+        jnp.concatenate([jnp.ones(1, jnp.uint32), jnp.zeros(fs.limbs - 1, jnp.uint32)]),
+        batch + (fs.limbs,),
+    )
+
+
+def constant(fs: FieldSpec, value: int) -> jax.Array:
+    """Embed a Python int as a compile-time limb constant."""
+    from .spec import int_to_limbs
+
+    return _u32(int_to_limbs(value % fs.modulus, fs.limbs))
+
+
+def add(fs: FieldSpec, a: jax.Array, b: jax.Array) -> jax.Array:
+    s = normalize(_u32(a) + _u32(b), fs.limbs + 1)  # limb sums < 2**17
+    return cond_sub(s, _u32(fs.p_limbs_ext))[..., : fs.limbs]
+
+
+def sub(fs: FieldSpec, a: jax.Array, b: jax.Array) -> jax.Array:
+    # (a + p) - b avoids signed intermediates; result in [0, 2p) then one
+    # conditional subtract.
+    ap = normalize(_u32(a) + _u32(fs.p_limbs), fs.limbs + 1)
+    b_ext = jnp.pad(_u32(b), [(0, 0)] * (jnp.ndim(b) - 1) + [(0, 1)])
+    d, _ = sub_with_borrow(*jnp.broadcast_arrays(ap, b_ext))
+    return cond_sub(d, _u32(fs.p_limbs_ext))[..., : fs.limbs]
+
+
+def neg(fs: FieldSpec, a: jax.Array) -> jax.Array:
+    return sub(fs, jnp.broadcast_to(zeros(fs), a.shape), a)
+
+
+def mul(fs: FieldSpec, a: jax.Array, b: jax.Array) -> jax.Array:
+    return barrett_reduce(fs, mul_wide(a, b))
+
+
+def square(fs: FieldSpec, a: jax.Array) -> jax.Array:
+    return mul(fs, a, a)
+
+
+def pow_const(fs: FieldSpec, x: jax.Array, e: int) -> jax.Array:
+    """x**e mod p for a compile-time exponent, via an MSB-first bit scan.
+
+    The exponent bits live in a tiny constant array and the square/multiply
+    body is traced once (lax.scan), keeping compile time flat even for
+    255-bit exponents (inverse = x**(p-2), Fermat).
+    """
+    if e < 0:
+        raise ValueError("negative exponent")
+    if e == 0:
+        return jnp.broadcast_to(ones(fs), x.shape)
+    bits = [int(b) for b in bin(e)[2:]]
+    bits_arr = jnp.asarray(bits, dtype=jnp.uint32)
+
+    def step(acc, bit):
+        acc = mul(fs, acc, acc)
+        acc_mul = mul(fs, acc, x)
+        acc = jnp.where(bit != 0, acc_mul, acc)
+        return acc, None
+
+    # Seed with 1 so the first iteration computes x**bits[0] uniformly.
+    init = jnp.broadcast_to(ones(fs), x.shape)
+    acc, _ = lax.scan(step, init, bits_arr)
+    return acc
+
+
+def inv(fs: FieldSpec, x: jax.Array) -> jax.Array:
+    """Fermat inverse x**(p-2); maps 0 -> 0 (callers guard zero)."""
+    return pow_const(fs, x, fs.modulus - 2)
+
+
+def batch_inv(fs: FieldSpec, x: jax.Array, axis: int = 0) -> jax.Array:
+    """Montgomery-trick batched inversion along ``axis``.
+
+    One Fermat inversion + 3(k-1) multiplies for k elements; used by
+    Lagrange reconstruction (reference: src/polynomial.rs:162-184) when
+    denominators are device-resident.  Zero inputs produce garbage in the
+    affected lane only (protocol code never inverts zero).
+    """
+    x = jnp.moveaxis(x, axis, 0)
+    k = x.shape[0]
+
+    def fwd(carry, xi):
+        nxt = mul(fs, carry, xi)
+        return nxt, carry  # prefix EXCLUSIVE product
+
+    total, prefix = lax.scan(fwd, jnp.broadcast_to(ones(fs), x.shape[1:]), x)
+    inv_total = inv(fs, total)
+
+    def bwd(carry, args):
+        xi, pre = args
+        out = mul(fs, carry, pre)  # = 1/xi
+        carry = mul(fs, carry, xi)  # strip xi from the running inverse
+        return carry, out
+
+    _, invs = lax.scan(bwd, inv_total, (x, prefix), reverse=True)
+    return jnp.moveaxis(invs, 0, axis)
+
+
+def eq(a: jax.Array, b: jax.Array) -> jax.Array:
+    return jnp.all(a == b, axis=-1)
+
+
+def is_zero(a: jax.Array) -> jax.Array:
+    return jnp.all(a == 0, axis=-1)
+
+
+def select(pred: jax.Array, a: jax.Array, b: jax.Array) -> jax.Array:
+    """Branchless limb-array select; pred shape == batch shape."""
+    return jnp.where(pred[..., None], a, b)

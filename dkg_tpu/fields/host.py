@@ -1,0 +1,104 @@
+"""Host-side (Python-int) reference field arithmetic.
+
+This is the bit-exact oracle the device path is tested against, and the
+implementation used for cold-path host work (point (de)compression,
+hash-to-group, Fiat-Shamir transcripts) where byte-twiddling is a poor TPU
+fit.  It mirrors the role `curve25519-dalek`'s scalar/field code plays for
+the reference (src/groups.rs:11-53).
+
+All functions take a :class:`~dkg_tpu.fields.spec.FieldSpec` and plain
+Python ints; batching helpers convert between ints and limb arrays.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .spec import FieldSpec, int_to_limbs, limbs_to_int
+
+
+def add(fs: FieldSpec, a: int, b: int) -> int:
+    return (a + b) % fs.modulus
+
+
+def sub(fs: FieldSpec, a: int, b: int) -> int:
+    return (a - b) % fs.modulus
+
+
+def mul(fs: FieldSpec, a: int, b: int) -> int:
+    return (a * b) % fs.modulus
+
+
+def neg(fs: FieldSpec, a: int) -> int:
+    return (-a) % fs.modulus
+
+
+def inv(fs: FieldSpec, a: int) -> int:
+    if a % fs.modulus == 0:
+        raise ZeroDivisionError("inverse of zero")
+    return pow(a, fs.modulus - 2, fs.modulus)
+
+
+def powmod(fs: FieldSpec, a: int, e: int) -> int:
+    return pow(a, e, fs.modulus)
+
+
+def to_bytes(fs: FieldSpec, a: int) -> bytes:
+    """Canonical little-endian encoding (reference: traits.rs:162-164)."""
+    return int(a % fs.modulus).to_bytes(fs.nbytes, "little")
+
+
+def from_bytes(fs: FieldSpec, data: bytes) -> int | None:
+    """Strict canonical decode; None on wrong length or value >= modulus.
+
+    Length is enforced so every element has exactly one accepted encoding
+    (wire-format non-malleability, as in the reference's fixed 32-byte
+    scalar/point encodings, traits.rs:162-164).
+    """
+    if len(data) != fs.nbytes:
+        return None
+    x = int.from_bytes(data, "little")
+    if x >= fs.modulus:
+        return None
+    return x
+
+
+def from_bytes_mod_order_wide(fs: FieldSpec, data: bytes) -> int:
+    """Reduce an oversized little-endian byte string mod p.
+
+    Used for hash-to-scalar (reference: traits.rs hash_to_scalar via
+    Blake2b, src/groups.rs:19-23): 64 uniform bytes reduced mod the group
+    order give a near-uniform scalar.
+    """
+    return int.from_bytes(data, "little") % fs.modulus
+
+
+# ---------------------------------------------------------------------------
+# int <-> limb-array conversion (batched)
+# ---------------------------------------------------------------------------
+
+
+def encode(fs: FieldSpec, values) -> np.ndarray:
+    """ints (scalar or nested list) -> uint32 limb array (..., L)."""
+    arr = np.asarray(values, dtype=object)
+    out = np.zeros(arr.shape + (fs.limbs,), dtype=np.uint32)
+    for idx in np.ndindex(arr.shape):
+        out[idx] = int_to_limbs(int(arr[idx]) % fs.modulus, fs.limbs)
+    if arr.shape == ():
+        return out.reshape(fs.limbs)
+    return out
+
+
+def decode(fs: FieldSpec, limbs) -> np.ndarray:
+    """uint32 limb array (..., L) -> object array of Python ints."""
+    limbs = np.asarray(limbs)
+    batch = limbs.shape[:-1]
+    out = np.empty(batch, dtype=object)
+    for idx in np.ndindex(batch):
+        out[idx] = limbs_to_int(limbs[idx])
+    return out
+
+
+def decode_int(fs: FieldSpec, limbs) -> int:
+    """Single limb vector -> int."""
+    return limbs_to_int(np.asarray(limbs))

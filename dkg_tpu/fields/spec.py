@@ -1,0 +1,152 @@
+"""Field specifications for the dkg_tpu limb arithmetic stack.
+
+Every scalar/base field used by the framework is described by a
+:class:`FieldSpec`: the modulus, the number of 16-bit limbs used for the
+device representation, and precomputed Barrett-reduction constants.
+
+Design notes (TPU-first):
+
+* TPUs have no native 64-bit integer multiply; products must be built from
+  16x16->32-bit multiplies that fit in ``uint32`` lanes.  We therefore
+  represent an N-bit field element as ``L`` little-endian 16-bit limbs
+  stored in a ``uint32`` array of shape ``(..., L)``.
+* Reduction is Barrett (not Montgomery) because Barrett exposes the work as
+  three large limb-convolutions — wide, batched, branch-free element-wise
+  ops that XLA vectorizes well — instead of a carried sequential CIOS loop.
+* All constants here are plain Python ints / numpy arrays computed once at
+  import; inside ``jit`` they become compile-time constants.
+
+Reference parity: this is the TPU-native replacement for the curve/field
+arithmetic the reference delegates to ``curve25519-dalek``
+(reference: src/traits.rs:142-238, src/groups.rs:11-90).  The reference is
+generic over a ``Scalar``/``PrimeGroupElement`` trait pair; here the same
+seam is a ``FieldSpec`` (+ group modules) so new curves plug in by
+registering their moduli.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import numpy as np
+
+LIMB_BITS = 16
+LIMB_MASK = (1 << LIMB_BITS) - 1
+
+
+def int_to_limbs(x: int, n_limbs: int) -> np.ndarray:
+    """Little-endian 16-bit limb decomposition of a non-negative int."""
+    if x < 0:
+        raise ValueError("int_to_limbs expects non-negative input")
+    out = np.zeros(n_limbs, dtype=np.uint32)
+    for i in range(n_limbs):
+        out[i] = x & LIMB_MASK
+        x >>= LIMB_BITS
+    if x != 0:
+        raise ValueError(f"value does not fit in {n_limbs} limbs")
+    return out
+
+
+def limbs_to_int(limbs) -> int:
+    """Inverse of :func:`int_to_limbs` (accepts any 1-D integer array)."""
+    acc = 0
+    for i, limb in enumerate(np.asarray(limbs, dtype=np.uint64).tolist()):
+        acc += int(limb) << (LIMB_BITS * i)
+    return acc
+
+
+@dataclasses.dataclass(frozen=True)
+class FieldSpec:
+    """A prime field with its device-representation parameters."""
+
+    name: str
+    modulus: int
+    limbs: int  # number of 16-bit limbs; modulus < 2**(16*limbs)
+
+    def __post_init__(self):
+        if self.modulus >= 1 << (LIMB_BITS * self.limbs):
+            raise ValueError("modulus does not fit in the limb budget")
+        # Barrett requires the top limb of p to be non-zero
+        # (p >= b**(L-1), b = 2**16) so the quotient estimate is tight.
+        if self.modulus < 1 << (LIMB_BITS * (self.limbs - 1)):
+            raise ValueError("modulus too small for limb count (Barrett)")
+
+    @property
+    def bits(self) -> int:
+        return self.modulus.bit_length()
+
+    @property
+    def nbytes(self) -> int:
+        """Canonical little-endian encoding length (reference: 32 bytes)."""
+        return (self.bits + 7) // 8
+
+    @functools.cached_property
+    def p_limbs(self) -> np.ndarray:
+        return int_to_limbs(self.modulus, self.limbs)
+
+    @functools.cached_property
+    def p_limbs_ext(self) -> np.ndarray:
+        """p padded to L+1 limbs (Barrett remainders live mod b**(L+1))."""
+        return int_to_limbs(self.modulus, self.limbs + 1)
+
+    @functools.cached_property
+    def barrett_mu(self) -> np.ndarray:
+        """floor(b**(2L) / p) as L+1 limbs."""
+        mu = (1 << (2 * LIMB_BITS * self.limbs)) // self.modulus
+        return int_to_limbs(mu, self.limbs + 1)
+
+    def rand_int(self, rng) -> int:
+        """Uniform field element from a host CSPRNG-style generator.
+
+        ``rng`` must expose ``randbits(k)`` (``random.SystemRandom`` or
+        ``random.Random`` for tests).  Rejection sampling keeps it uniform.
+        """
+        while True:
+            x = rng.getrandbits(self.bits)
+            if x < self.modulus:
+                return x
+
+
+# --------------------------------------------------------------------------
+# Registry of the concrete fields the framework ships with.
+#
+# Curve25519 / Ristretto (the reference's only backend, src/groups.rs):
+#   base field p = 2^255 - 19, scalar field l = 2^252 + 27742...493.
+# secp256k1 (BASELINE.json north-star curve).
+# BLS12-381 G1 (BASELINE.json config #5, threshold-BLS).
+# --------------------------------------------------------------------------
+
+P25519 = FieldSpec("ed25519_base", (1 << 255) - 19, 16)
+L25519 = FieldSpec(
+    "ed25519_scalar",
+    (1 << 252) + 27742317777372353535851937790883648493,
+    16,
+)
+
+SECP256K1_P = FieldSpec(
+    "secp256k1_base",
+    (1 << 256) - (1 << 32) - 977,
+    16,
+)
+SECP256K1_N = FieldSpec(
+    "secp256k1_scalar",
+    0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141,
+    16,
+)
+
+BLS12_381_P = FieldSpec(
+    "bls12_381_base",
+    0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB,
+    24,
+)
+BLS12_381_R = FieldSpec(
+    "bls12_381_scalar",
+    0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001,
+    16,
+)
+
+ALL_FIELDS = {
+    fs.name: fs
+    for fs in (P25519, L25519, SECP256K1_P, SECP256K1_N, BLS12_381_P, BLS12_381_R)
+}
