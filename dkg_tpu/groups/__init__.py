@@ -1,0 +1,14 @@
+"""Prime-order group backends: host oracle + batched TPU device path.
+
+Reference seam parity: src/traits.rs:142-238 (Scalar / PrimeGroupElement)
+and src/groups.rs (Ristretto255 backend).  Concrete backends here:
+ristretto255, secp256k1, bls12_381_g1.
+"""
+
+from .host import (  # noqa: F401
+    ALL_GROUPS,
+    BLS12_381_G1,
+    RISTRETTO255,
+    SECP256K1,
+    HostGroup,
+)

@@ -1,0 +1,521 @@
+"""Host-side (Python-int) prime-order group backends.
+
+This is the bit-exact oracle the device path is tested against, plus the
+implementation of the cold-path byte-level operations (point compression,
+hash-to-group, canonical decoding) that are a poor TPU fit and sit at
+message boundaries, not in hot loops.
+
+Role parity with the reference: the reference is generic over a
+``Scalar``/``PrimeGroupElement`` trait pair (reference: src/traits.rs:142,
+:204) with one concrete backend, Ristretto255 via curve25519-dalek
+(reference: src/groups.rs:11-90).  Here the same seam is the
+:class:`HostGroup` interface; concrete backends are
+
+* :data:`RISTRETTO255` — Edwards25519 + the Ristretto255 construction
+  (encode/decode/equality/one-way-map per the published RFC 9496
+  algorithms — implemented from the spec, not translated from dalek);
+* :data:`SECP256K1` and :data:`BLS12_381_G1` — short Weierstrass a=0
+  curves (the BASELINE.json extension targets the reference's trait
+  docs invite, src/traits.rs:15-130).
+
+Scalar-field helpers (``hash_to_scalar``, ``random_scalar``) mirror
+reference src/traits.rs:142-179.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Optional
+
+from ..fields import spec as fspec
+from ..fields.spec import FieldSpec
+
+# ---------------------------------------------------------------------------
+# Edwards25519 / Ristretto255 constants
+# ---------------------------------------------------------------------------
+
+P = (1 << 255) - 19
+ELL = (1 << 252) + 27742317777372353535851937790883648493
+
+D = (-121665 * pow(121666, P - 2, P)) % P
+SQRT_M1 = pow(2, (P - 1) // 4, P)  # sqrt(-1), the even root
+
+# Ristretto helper constants (RFC 9496 §4.1)
+ONE_MINUS_D_SQ = (1 - D * D) % P
+D_MINUS_ONE_SQ = ((D - 1) * (D - 1)) % P
+
+_BASE_Y = (4 * pow(5, P - 2, P)) % P
+
+
+def _recover_x(y: int, sign: int) -> Optional[int]:
+    """x with x**2 = (y**2-1)/(d*y**2+1), choosing parity = sign."""
+    x2 = (y * y - 1) * pow(D * y * y + 1, P - 2, P) % P
+    x = pow(x2, (P + 3) // 8, P)
+    if (x * x - x2) % P != 0:
+        x = x * SQRT_M1 % P
+    if (x * x - x2) % P != 0:
+        return None
+    if x & 1 != sign:
+        x = P - x
+    return x
+
+
+_BASE_X = _recover_x(_BASE_Y, 0)
+
+# Extended twisted Edwards coordinates (X, Y, Z, T), T = X*Y/Z, a = -1.
+EdPoint = tuple  # (int, int, int, int)
+
+ED_IDENTITY: EdPoint = (0, 1, 1, 0)
+ED_GENERATOR: EdPoint = (_BASE_X, _BASE_Y, 1, _BASE_X * _BASE_Y % P)
+
+
+def ed_add(p: EdPoint, q: EdPoint) -> EdPoint:
+    """Unified extended addition (complete for a=-1, d non-square)."""
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = (y1 - x1) * (y2 - x2) % P
+    b = (y1 + x1) * (y2 + x2) % P
+    c = 2 * D * t1 % P * t2 % P
+    dd = 2 * z1 * z2 % P
+    e, f, g, h = (b - a) % P, (dd - c) % P, (dd + c) % P, (b + a) % P
+    return (e * f % P, g * h % P, f * g % P, e * h % P)
+
+
+def ed_neg(p: EdPoint) -> EdPoint:
+    x, y, z, t = p
+    return ((P - x) % P, y, z, (P - t) % P)
+
+
+def ed_scalar_mul(k: int, p: EdPoint) -> EdPoint:
+    k %= ELL
+    acc = ED_IDENTITY
+    while k:
+        if k & 1:
+            acc = ed_add(acc, p)
+        p = ed_add(p, p)
+        k >>= 1
+    return acc
+
+
+def _sqrt_ratio_m1(u: int, v: int) -> tuple[bool, int]:
+    """RFC 9496 §4.2 SQRT_RATIO_M1: non-negative sqrt of u/v (or i*u/v)."""
+    v3 = v * v % P * v % P
+    v7 = v3 * v3 % P * v % P
+    r = u * v3 % P * pow(u * v7 % P, (P - 5) // 8, P) % P
+    check = v * r % P * r % P
+    u_neg = (P - u) % P
+    correct_sign = check == u % P
+    flipped_sign = check == u_neg
+    flipped_sign_i = check == u_neg * SQRT_M1 % P
+    if flipped_sign or flipped_sign_i:
+        r = r * SQRT_M1 % P
+    if r & 1:
+        r = P - r
+    return (correct_sign or flipped_sign), r
+
+
+_, INVSQRT_A_MINUS_D = _sqrt_ratio_m1(1, (-1 - D) % P)
+_, SQRT_AD_MINUS_ONE = _sqrt_ratio_m1((-D - 1) % P, 1)
+
+
+def ristretto_encode(p: EdPoint) -> bytes:
+    """RFC 9496 §4.3.2 ENCODE."""
+    x0, y0, z0, t0 = p
+    u1 = (z0 + y0) * (z0 - y0) % P
+    u2 = x0 * y0 % P
+    _, invsqrt = _sqrt_ratio_m1(1, u1 * u2 % P * u2 % P)
+    den1 = invsqrt * u1 % P
+    den2 = invsqrt * u2 % P
+    z_inv = den1 * den2 % P * t0 % P
+    ix0 = x0 * SQRT_M1 % P
+    iy0 = y0 * SQRT_M1 % P
+    enchanted = den1 * INVSQRT_A_MINUS_D % P
+    rotate = (t0 * z_inv % P) & 1
+    if rotate:
+        x, y, den_inv = iy0, ix0, enchanted
+    else:
+        x, y, den_inv = x0, y0, den2
+    if (x * z_inv % P) & 1:
+        y = (P - y) % P
+    s = den_inv * ((z0 - y) % P) % P
+    if s & 1:
+        s = P - s
+    return s.to_bytes(32, "little")
+
+
+def ristretto_decode(data: bytes) -> Optional[EdPoint]:
+    """RFC 9496 §4.3.1 DECODE; None for non-canonical encodings."""
+    if len(data) != 32:
+        return None
+    s = int.from_bytes(data, "little")
+    if s >= P or s & 1:
+        return None
+    ss = s * s % P
+    u1 = (1 - ss) % P
+    u2 = (1 + ss) % P
+    u2_sqr = u2 * u2 % P
+    v = ((P - D) * u1 % P * u1 + P - u2_sqr) % P
+    was_square, invsqrt = _sqrt_ratio_m1(1, v * u2_sqr % P)
+    den_x = invsqrt * u2 % P
+    den_y = invsqrt * den_x % P * v % P
+    x = 2 * s % P * den_x % P
+    if x & 1:
+        x = P - x
+    y = u1 * den_y % P
+    t = x * y % P
+    if (not was_square) or t & 1 or y == 0:
+        return None
+    return (x, y, 1, t)
+
+
+def ristretto_eq(p: EdPoint, q: EdPoint) -> bool:
+    """Torsion-safe equality (RFC 9496 §4.3.3): X1Y2==Y1X2 or Y1Y2==X1X2."""
+    x1, y1, _, _ = p
+    x2, y2, _, _ = q
+    return (x1 * y2 - y1 * x2) % P == 0 or (y1 * y2 - x1 * x2) % P == 0
+
+
+def ristretto_map(t: int) -> EdPoint:
+    """RFC 9496 §4.3.4 MAP: field element -> group element."""
+    r = SQRT_M1 * t % P * t % P
+    u = (r + 1) * ONE_MINUS_D_SQ % P
+    v = ((P - 1) + P - r * D % P) % P * ((r + D) % P) % P
+    was_square, s = _sqrt_ratio_m1(u, v)
+    s_prime = s * t % P
+    if not s_prime & 1:
+        s_prime = P - s_prime  # -ABS(s*t)
+    if not was_square:
+        s, c = s_prime, r
+    else:
+        c = P - 1
+    n = (c * ((r - 1) % P) % P * D_MINUS_ONE_SQ + P - v) % P
+    w0 = 2 * s * v % P
+    w1 = n * SQRT_AD_MINUS_ONE % P
+    w2 = (1 - s * s) % P
+    w3 = (1 + s * s) % P
+    return (w0 * w3 % P, w2 * w1 % P, w1 * w3 % P, w0 * w2 % P)
+
+
+# ---------------------------------------------------------------------------
+# Short Weierstrass (a = 0) host arithmetic — secp256k1, BLS12-381 G1
+# ---------------------------------------------------------------------------
+
+# Points are projective (X, Y, Z); identity is (0, 1, 0).
+WsPoint = tuple
+
+
+def ws_add(p: WsPoint, q: WsPoint, prime: int, b3: int) -> WsPoint:
+    """Complete projective addition for y^2 = x^3 + b (Renes-Costello-Batina
+    2015, algorithm 7).  Branchless-complete: handles identity & doubling."""
+    x1, y1, z1 = p
+    x2, y2, z2 = q
+    t0 = x1 * x2 % prime
+    t1 = y1 * y2 % prime
+    t2 = z1 * z2 % prime
+    t3 = (x1 + y1) * (x2 + y2) % prime
+    t3 = (t3 - t0 - t1) % prime
+    t4 = (y1 + z1) * (y2 + z2) % prime
+    t4 = (t4 - t1 - t2) % prime
+    x3 = (x1 + z1) * (x2 + z2) % prime
+    y3 = (x3 - t0 - t2) % prime
+    x3 = t0 * 3 % prime
+    t2 = b3 * t2 % prime
+    z3 = (t1 + t2) % prime
+    t1 = (t1 - t2) % prime
+    y3 = b3 * y3 % prime
+    x3_out = (t3 * t1 - y3 * t4) % prime
+    t1y3 = t1 * z3 % prime  # reuse names carefully below
+    y3_out = (t1y3 + x3 * y3) % prime
+    z3_out = (z3 * t4 + x3 * t3) % prime
+    return (x3_out, y3_out, z3_out)
+
+
+def ws_neg(p: WsPoint, prime: int) -> WsPoint:
+    x, y, z = p
+    return (x, (prime - y) % prime, z)
+
+
+def ws_eq(p: WsPoint, q: WsPoint, prime: int) -> bool:
+    """Projective equality: cross-multiply (handles identity Z=0)."""
+    x1, y1, z1 = p
+    x2, y2, z2 = q
+    if z1 % prime == 0 or z2 % prime == 0:
+        return z1 % prime == z2 % prime
+    return (x1 * z2 - x2 * z1) % prime == 0 and (y1 * z2 - y2 * z1) % prime == 0
+
+
+# ---------------------------------------------------------------------------
+# Backend classes
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class HostGroup:
+    """Common host interface over a prime-order group.
+
+    Reference-parity surface (src/traits.rs):
+      generator/zero/hash_to_group/to_bytes/from_bytes ~ PrimeGroupElement
+      (:204-238); random_scalar/hash_to_scalar ~ Scalar (:142-179);
+      multiscalar multiplication ~ :234-237 (host fallback form).
+    """
+
+    name: str
+    base_field: FieldSpec
+    scalar_field: FieldSpec
+
+    # -- scalar helpers (reference: src/traits.rs:142-179) ------------------
+
+    def random_scalar(self, rng) -> int:
+        return self.scalar_field.rand_int(rng)
+
+    def hash_to_scalar(self, data: bytes, domain: bytes = b"") -> int:
+        """Blake2b-512 reduced mod group order (reference: groups.rs:19-23)."""
+        h = hashlib.blake2b(data, digest_size=64, person=_person(domain)).digest()
+        return int.from_bytes(h, "little") % self.scalar_field.modulus
+
+    def scalar_to_bytes(self, s: int) -> bytes:
+        return int(s % self.scalar_field.modulus).to_bytes(
+            self.scalar_field.nbytes, "little"
+        )
+
+    def scalar_from_bytes(self, data: bytes) -> Optional[int]:
+        if len(data) != self.scalar_field.nbytes:
+            return None
+        x = int.from_bytes(data, "little")
+        return x if x < self.scalar_field.modulus else None
+
+    # -- group element interface (overridden per backend) -------------------
+
+    def identity(self):
+        raise NotImplementedError
+
+    def generator(self):
+        raise NotImplementedError
+
+    def add(self, p, q):
+        raise NotImplementedError
+
+    def neg(self, p):
+        raise NotImplementedError
+
+    def sub(self, p, q):
+        return self.add(p, self.neg(q))
+
+    def scalar_mul(self, k: int, p):
+        k %= self.scalar_field.modulus
+        acc, base = self.identity(), p
+        while k:
+            if k & 1:
+                acc = self.add(acc, base)
+            base = self.add(base, base)
+            k >>= 1
+        return acc
+
+    def eq(self, p, q) -> bool:
+        raise NotImplementedError
+
+    def encode(self, p) -> bytes:
+        raise NotImplementedError
+
+    def decode(self, data: bytes):
+        raise NotImplementedError
+
+    def hash_to_group(self, data: bytes, domain: bytes = b""):
+        raise NotImplementedError
+
+    def msm(self, scalars, points):
+        """Host multi-scalar multiplication (reference: traits.rs:234-237)."""
+        acc = self.identity()
+        for k, p in zip(scalars, points):
+            acc = self.add(acc, self.scalar_mul(k, p))
+        return acc
+
+    def is_identity(self, p) -> bool:
+        return self.eq(p, self.identity())
+
+
+def _person(domain: bytes) -> bytes:
+    """Blake2b personalisation from a domain tag (<=16 bytes)."""
+    return domain[:16]
+
+
+class Ristretto255(HostGroup):
+    def identity(self) -> EdPoint:
+        return ED_IDENTITY
+
+    def generator(self) -> EdPoint:
+        return ED_GENERATOR
+
+    def add(self, p, q):
+        return ed_add(p, q)
+
+    def neg(self, p):
+        return ed_neg(p)
+
+    def eq(self, p, q) -> bool:
+        return ristretto_eq(p, q)
+
+    def encode(self, p) -> bytes:
+        return ristretto_encode(p)
+
+    def decode(self, data: bytes):
+        return ristretto_decode(data)
+
+    def hash_to_group(self, data: bytes, domain: bytes = b"") -> EdPoint:
+        """One-way map: Blake2b-512 -> two field elements -> MAP -> add
+        (RFC 9496 §4.3.4; reference derives h the same shape via
+        from_hash, commitment.rs:13-17)."""
+        h = hashlib.blake2b(data, digest_size=64, person=_person(domain)).digest()
+        mask = (1 << 255) - 1
+        t0 = (int.from_bytes(h[:32], "little") & mask) % P
+        t1 = (int.from_bytes(h[32:], "little") & mask) % P
+        return ed_add(ristretto_map(t0), ristretto_map(t1))
+
+
+@dataclass(frozen=True)
+class WeierstrassGroup(HostGroup):
+    """y^2 = x^3 + b over F_p, prime order n (a = 0), compressed SEC-style
+    encoding (parity byte || big-endian x).  Cofactor-1 for secp256k1;
+    BLS12-381 G1 clears its cofactor on hash."""
+
+    b: int = 0
+    gen_x: int = 0
+    gen_y: int = 0
+    cofactor: int = 1
+
+    @property
+    def prime(self) -> int:
+        return self.base_field.modulus
+
+    @property
+    def b3(self) -> int:
+        return 3 * self.b % self.prime
+
+    def identity(self) -> WsPoint:
+        return (0, 1, 0)
+
+    def generator(self) -> WsPoint:
+        return (self.gen_x, self.gen_y, 1)
+
+    def add(self, p, q):
+        return ws_add(p, q, self.prime, self.b3)
+
+    def neg(self, p):
+        return ws_neg(p, self.prime)
+
+    def eq(self, p, q) -> bool:
+        return ws_eq(p, q, self.prime)
+
+    def to_affine(self, p) -> Optional[tuple[int, int]]:
+        x, y, z = p
+        if z % self.prime == 0:
+            return None
+        zi = pow(z, self.prime - 2, self.prime)
+        return (x * zi % self.prime, y * zi % self.prime)
+
+    def encode(self, p) -> bytes:
+        aff = self.to_affine(p)
+        nb = self.base_field.nbytes
+        if aff is None:  # identity: all-zero encoding (SEC 00 byte, padded)
+            return bytes(1 + nb)
+        x, y = aff
+        return bytes([2 + (y & 1)]) + x.to_bytes(nb, "big")
+
+    def decode(self, data: bytes):
+        nb = self.base_field.nbytes
+        if len(data) != 1 + nb:
+            return None
+        if data == bytes(1 + nb):
+            return self.identity()
+        tag = data[0]
+        if tag not in (2, 3):
+            return None
+        x = int.from_bytes(data[1:], "big")
+        if x >= self.prime:
+            return None
+        y = self._lift_x(x, tag & 1)
+        if y is None:
+            return None
+        pt = (x, y, 1)
+        if self.cofactor != 1 and not self._in_subgroup(pt):
+            return None
+        return pt
+
+    def _lift_x(self, x: int, parity: int) -> Optional[int]:
+        rhs = (x * x % self.prime * x + self.b) % self.prime
+        y = _sqrt_mod(rhs, self.prime)
+        if y is None:
+            return None
+        if y & 1 != parity:
+            y = self.prime - y
+        return y
+
+    def _in_subgroup(self, p) -> bool:
+        return ws_eq(self._mul_int(self.scalar_field.modulus, p), (0, 1, 0), self.prime)
+
+    def _mul_int(self, k: int, p):
+        """Scalar mult by an arbitrary integer (not reduced mod order)."""
+        acc, base = self.identity(), p
+        while k:
+            if k & 1:
+                acc = self.add(acc, base)
+            base = self.add(base, base)
+            k >>= 1
+        return acc
+
+    def hash_to_group(self, data: bytes, domain: bytes = b""):
+        """Try-and-increment with cofactor clearing.
+
+        Variable-time, but only used on public inputs (commitment-key
+        derivation, reference commitment.rs:13-17), never on secrets.
+        """
+        ctr = 0
+        while True:
+            h = hashlib.blake2b(
+                data + ctr.to_bytes(4, "little"),
+                digest_size=self.base_field.nbytes + 16,
+                person=_person(domain),
+            ).digest()
+            x = int.from_bytes(h, "little") % self.prime
+            y = self._lift_x(x, 0)
+            if y is not None:
+                pt = self._mul_int(self.cofactor, (x, y, 1))
+                if not self.eq(pt, self.identity()):
+                    return pt
+            ctr += 1
+
+
+def _sqrt_mod(a: int, p: int) -> Optional[int]:
+    """Square root mod p for p % 4 == 3 (secp256k1, BLS12-381)."""
+    assert p % 4 == 3
+    r = pow(a, (p + 1) // 4, p)
+    return r if r * r % p == a % p else None
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+RISTRETTO255 = Ristretto255("ristretto255", fspec.P25519, fspec.L25519)
+
+SECP256K1 = WeierstrassGroup(
+    "secp256k1",
+    fspec.SECP256K1_P,
+    fspec.SECP256K1_N,
+    b=7,
+    gen_x=0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798,
+    gen_y=0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8,
+)
+
+BLS12_381_G1 = WeierstrassGroup(
+    "bls12_381_g1",
+    fspec.BLS12_381_P,
+    fspec.BLS12_381_R,
+    b=4,
+    gen_x=0x17F1D3A73197D7942695638C4FA9AC0FC3688C4F9774B905A14E3A3F171BAC586C55E83FF97A1AEFFB3AF00ADB22C6BB,
+    gen_y=0x08B3F481E3AAA0F1A09E30ED741D8AE4FCF5E095D5D00AF600DB18CB2C04B3EDD03CC744A2888AE40CAA232946C5E7E1,
+    cofactor=0x396C8C005555E1568C00AAAB0000AAAB,
+)
+
+ALL_GROUPS = {g.name: g for g in (RISTRETTO255, SECP256K1, BLS12_381_G1)}

@@ -1,0 +1,129 @@
+"""Host group-backend tests.
+
+Adds what the reference lacks per SURVEY §4: known-answer vectors (RFC 9496
+for Ristretto255; SEC2/BLS standard generators) on top of the reference's
+internal-consistency oracle style.
+"""
+
+import random
+
+import pytest
+
+from dkg_tpu.groups import host as gh
+
+RNG = random.Random(0x6E0)
+
+GROUPS = [gh.RISTRETTO255, gh.SECP256K1, gh.BLS12_381_G1]
+GROUP_IDS = [g.name for g in GROUPS]
+
+# RFC 9496 §A.1 — encodings of B, 2B, ... (small multiples of the generator)
+RISTRETTO_MULTIPLES = [
+    "0000000000000000000000000000000000000000000000000000000000000000",
+    "e2f2ae0a6abc4e71a884a961c500515f58e30b6aa582dd8db6a65945e08d2d76",
+    "6a493210f7499cd17fecb510ae0cea23a110e8d5b901f8acadd3095c73a3b919",
+    "94741f5d5d52755ece4f23f044ee27d5d1ea1e2bd196b462166b16152a9d0259",
+    "da80862773358b466ffadfe0b3293ab3d9fd53c5ea6c955358f568322daf6a57",
+]
+
+# RFC 9496 §A.3 — non-canonical / invalid encodings that MUST be rejected
+RISTRETTO_BAD = [
+    "00ffffffffffffffffffffffffffffffffffffffffffffffffffffffffffffff",
+    "ffffffffffffffffffffffffffffffffffffffffffffffffffffffffffffff7f",
+    "f3ffffffffffffffffffffffffffffffffffffffffffffffffffffffffffff7f",
+    "edffffffffffffffffffffffffffffffffffffffffffffffffffffffffffff7f",
+    "0100000000000000000000000000000000000000000000000000000000000000",
+    "ecffffffffffffffffffffffffffffffffffffffffffffffffffffffffffff7f",
+]
+
+
+def test_ristretto_generator_multiples():
+    g = gh.RISTRETTO255
+    acc = g.identity()
+    for i, expect in enumerate(RISTRETTO_MULTIPLES):
+        assert g.encode(acc).hex() == expect, f"multiple {i}"
+        assert g.eq(g.decode(bytes.fromhex(expect)), acc)
+        acc = g.add(acc, g.generator())
+
+
+def test_ristretto_rejects_bad_encodings():
+    g = gh.RISTRETTO255
+    for bad in RISTRETTO_BAD:
+        assert g.decode(bytes.fromhex(bad)) is None, bad
+
+
+@pytest.mark.parametrize("g", GROUPS, ids=GROUP_IDS)
+def test_group_laws(g):
+    a = g.random_scalar(RNG)
+    b = g.random_scalar(RNG)
+    pa = g.scalar_mul(a, g.generator())
+    pb = g.scalar_mul(b, g.generator())
+    # homomorphism: (a+b)G == aG + bG
+    ab = (a + b) % g.scalar_field.modulus
+    assert g.eq(g.scalar_mul(ab, g.generator()), g.add(pa, pb))
+    # commutativity / inverse / identity
+    assert g.eq(g.add(pa, pb), g.add(pb, pa))
+    assert g.is_identity(g.add(pa, g.neg(pa)))
+    assert g.eq(g.add(pa, g.identity()), pa)
+    # order: ell * G == identity
+    assert g.is_identity(g.scalar_mul(0, g.generator()))
+
+
+@pytest.mark.parametrize("g", GROUPS, ids=GROUP_IDS)
+def test_encode_decode_roundtrip(g):
+    for _ in range(4):
+        p = g.scalar_mul(g.random_scalar(RNG), g.generator())
+        assert g.eq(g.decode(g.encode(p)), p)
+    # identity round-trips
+    assert g.is_identity(g.decode(g.encode(g.identity())))
+    # wrong-length and garbage encodings rejected
+    assert g.decode(b"\x01") is None
+
+
+@pytest.mark.parametrize("g", GROUPS, ids=GROUP_IDS)
+def test_hash_to_group_valid_and_deterministic(g):
+    p1 = g.hash_to_group(b"dkg_tpu shared string")
+    p2 = g.hash_to_group(b"dkg_tpu shared string")
+    p3 = g.hash_to_group(b"another string")
+    assert g.eq(p1, p2)
+    assert not g.eq(p1, p3)
+    assert not g.is_identity(p1)
+    # result is in the prime-order subgroup: ell * P == identity
+    assert g.is_identity(_mul_int(g, g.scalar_field.modulus, p1))
+
+
+def _mul_int(g, k, p):
+    acc, base = g.identity(), p
+    while k:
+        if k & 1:
+            acc = g.add(acc, base)
+        base = g.add(base, base)
+        k >>= 1
+    return acc
+
+
+@pytest.mark.parametrize("g", GROUPS, ids=GROUP_IDS)
+def test_msm_matches_naive(g):
+    ks = [g.random_scalar(RNG) for _ in range(5)]
+    ps = [g.scalar_mul(g.random_scalar(RNG), g.generator()) for _ in range(5)]
+    expect = g.identity()
+    for k, p in zip(ks, ps):
+        expect = g.add(expect, g.scalar_mul(k, p))
+    assert g.eq(g.msm(ks, ps), expect)
+
+
+@pytest.mark.parametrize("g", GROUPS, ids=GROUP_IDS)
+def test_hash_to_scalar_range(g):
+    for msg in (b"", b"a", b"x" * 1000):
+        s = g.hash_to_scalar(msg)
+        assert 0 <= s < g.scalar_field.modulus
+
+
+def test_secp256k1_generator_order():
+    g = gh.SECP256K1
+    # nG == identity for the standard generator (KAT for curve constants)
+    assert g.is_identity(_mul_int(g, g.scalar_field.modulus, g.generator()))
+
+
+def test_bls12_381_generator_order():
+    g = gh.BLS12_381_G1
+    assert g.is_identity(_mul_int(g, g.scalar_field.modulus, g.generator()))
