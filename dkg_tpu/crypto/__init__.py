@@ -1,0 +1,18 @@
+"""Cryptographic building blocks (reference: src/cryptography/mod.rs:1-5)."""
+
+from .commitment import CommitmentKey, Open, commit, commit_with_random, verify  # noqa: F401
+from .correct_decryption import CorrectHybridDecrKeyZkp  # noqa: F401
+from .dleq import DleqZkp  # noqa: F401
+from .elgamal import (  # noqa: F401
+    Ciphertext,
+    HybridCiphertext,
+    Keypair,
+    SymmetricKey,
+    decrypt_point,
+    encrypt,
+    encrypt_point,
+    hybrid_decrypt,
+    hybrid_decrypt_with_key,
+    hybrid_encrypt,
+    recover_symmetric_key,
+)

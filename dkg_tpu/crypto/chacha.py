@@ -1,0 +1,72 @@
+"""ChaCha20 stream cipher (RFC 8439), pure Python.
+
+The DEM half of the hybrid encryption scheme (reference: elgamal.rs uses
+the `chacha20` crate, Cargo.toml:13).  Byte-stream ciphers are a poor TPU
+fit and sit off the hot path (SURVEY §7 step 4), so this stays host-side;
+share payloads are tiny (one scalar = 32 bytes).
+
+Implemented from the RFC, with numpy for the 16-lane state arithmetic.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_CONSTANTS = np.array(
+    [0x61707865, 0x3320646E, 0x79622D32, 0x6B206574], dtype=np.uint32
+)
+
+
+def _rotl(x: np.ndarray, n: int) -> np.ndarray:
+    return (x << np.uint32(n)) | (x >> np.uint32(32 - n))
+
+
+def _quarter(state: np.ndarray, a: int, b: int, c: int, d: int) -> None:
+    state[a] += state[b]
+    state[d] = _rotl(state[d] ^ state[a], 16)
+    state[c] += state[d]
+    state[b] = _rotl(state[b] ^ state[c], 12)
+    state[a] += state[b]
+    state[d] = _rotl(state[d] ^ state[a], 8)
+    state[c] += state[d]
+    state[b] = _rotl(state[b] ^ state[c], 7)
+
+
+def _block(key_words: np.ndarray, counter: int, nonce_words: np.ndarray) -> bytes:
+    state = np.concatenate(
+        [
+            _CONSTANTS,
+            key_words,
+            np.array([counter], dtype=np.uint32),
+            nonce_words,
+        ]
+    )
+    working = state.copy()
+    with np.errstate(over="ignore"):
+        for _ in range(10):
+            _quarter(working, 0, 4, 8, 12)
+            _quarter(working, 1, 5, 9, 13)
+            _quarter(working, 2, 6, 10, 14)
+            _quarter(working, 3, 7, 11, 15)
+            _quarter(working, 0, 5, 10, 15)
+            _quarter(working, 1, 6, 11, 12)
+            _quarter(working, 2, 7, 8, 13)
+            _quarter(working, 3, 4, 9, 14)
+        working += state
+    return working.astype("<u4").tobytes()
+
+
+def chacha20_xor(key: bytes, nonce: bytes, data: bytes, counter: int = 0) -> bytes:
+    """XOR ``data`` with the ChaCha20 keystream (encrypt == decrypt)."""
+    if len(key) != 32:
+        raise ValueError("key must be 32 bytes")
+    if len(nonce) != 12:
+        raise ValueError("nonce must be 12 bytes (IETF variant)")
+    key_words = np.frombuffer(key, dtype="<u4").astype(np.uint32)
+    nonce_words = np.frombuffer(nonce, dtype="<u4").astype(np.uint32)
+    out = bytearray()
+    for i in range(0, len(data), 64):
+        ks = _block(key_words, counter + i // 64, nonce_words)
+        chunk = data[i : i + 64]
+        out.extend(b ^ k for b, k in zip(chunk, ks))
+    return bytes(out)

@@ -1,0 +1,56 @@
+"""Pedersen commitments over any HostGroup backend.
+
+Functional parity with the reference (reference:
+src/cryptography/commitment.rs): commitment key derived from a shared
+string by hash-to-group (no trusted setup, :13-17), commit = g*m + h*r
+(:24-26), verify (:54-57), Open (:60-64).  The batched device twin of
+``commit`` lives in the ceremony engine (double fixed-base kernel,
+SURVEY §2 table row 2).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..groups.host import HostGroup
+
+DOMAIN_COMMITMENT_KEY = b"dkgtpu-ck"
+
+
+@dataclass(frozen=True)
+class CommitmentKey:
+    """The second Pedersen base ``h`` (reference: commitment.rs:7-9)."""
+
+    h: tuple
+
+    @classmethod
+    def generate(cls, group: HostGroup, shared_string: bytes) -> "CommitmentKey":
+        """Deterministic from the ceremony's shared string — every party
+        derives the same ``h`` (reference: commitment.rs:13-17)."""
+        return cls(group.hash_to_group(shared_string, DOMAIN_COMMITMENT_KEY))
+
+
+@dataclass(frozen=True)
+class Open:
+    """A commitment opening (m, r) (reference: commitment.rs:60-64)."""
+
+    m: int
+    r: int
+
+
+def commit_with_random(group: HostGroup, ck: CommitmentKey, m: int, r: int):
+    """g*m + h*r (reference: commitment.rs:24-26)."""
+    return group.add(
+        group.scalar_mul(m, group.generator()), group.scalar_mul(r, ck.h)
+    )
+
+
+def commit(group: HostGroup, ck: CommitmentKey, m: int, rng) -> tuple:
+    """Commit with fresh randomness; returns (commitment, Open)."""
+    r = group.random_scalar(rng)
+    return commit_with_random(group, ck, m, r), Open(m, r)
+
+
+def verify(group: HostGroup, ck: CommitmentKey, commitment, o: Open) -> bool:
+    """Recompute-and-compare (reference: commitment.rs:54-57)."""
+    return group.eq(commitment, commit_with_random(group, ck, o.m, o.r))

@@ -1,0 +1,64 @@
+"""Chaum-Pedersen discrete-log-equality NIZK (Fiat-Shamir via Blake2b).
+
+Functional parity with the reference (reference:
+src/cryptography/dl_equality/zkp.rs and challenge_context.rs): proves
+knowledge of x with point1 = base1*x and point2 = base2*x; proof is
+(challenge, response) with the challenge recomputed on verify.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+
+from ..groups.host import HostGroup
+
+DOMAIN_DLEQ = b"dkgtpu-dleq"
+
+
+def _challenge(
+    group: HostGroup, base1, base2, point1, point2, a1, a2
+) -> int:
+    """Fiat-Shamir challenge over the full transcript (reference:
+    challenge_context.rs:10-42 feeds bases, statement points, and both
+    announcements into Blake2b)."""
+    h = hashlib.blake2b(digest_size=64, person=DOMAIN_DLEQ)
+    for p in (base1, base2, point1, point2, a1, a2):
+        h.update(group.encode(p))
+    return int.from_bytes(h.digest(), "little") % group.scalar_field.modulus
+
+
+@dataclass(frozen=True)
+class DleqZkp:
+    """(challenge, response) (reference: zkp.rs:22-25)."""
+
+    challenge: int
+    response: int
+
+    @classmethod
+    def generate(
+        cls, group: HostGroup, base1, base2, point1, point2, dlog: int, rng
+    ) -> "DleqZkp":
+        """Announce a_i = base_i*w, challenge e = H(transcript),
+        response z = w + e*dlog (reference: zkp.rs:29-51)."""
+        w = group.random_scalar(rng)
+        a1 = group.scalar_mul(w, base1)
+        a2 = group.scalar_mul(w, base2)
+        e = _challenge(group, base1, base2, point1, point2, a1, a2)
+        z = (w + e * dlog) % group.scalar_field.modulus
+        return cls(e, z)
+
+    def verify(self, group: HostGroup, base1, base2, point1, point2) -> bool:
+        """Recompute announcements a_i = base_i*z - point_i*e and check the
+        challenge matches (reference: zkp.rs:54-74)."""
+        a1 = group.sub(
+            group.scalar_mul(self.response, base1),
+            group.scalar_mul(self.challenge, point1),
+        )
+        a2 = group.sub(
+            group.scalar_mul(self.response, base2),
+            group.scalar_mul(self.challenge, point2),
+        )
+        return self.challenge == _challenge(
+            group, base1, base2, point1, point2, a1, a2
+        )

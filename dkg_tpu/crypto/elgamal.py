@@ -1,0 +1,142 @@
+"""Lifted ElGamal + hybrid (KEM/DEM) encryption over any HostGroup.
+
+Functional parity with the reference (reference:
+src/cryptography/elgamal.rs): keypairs (:52-131), lifted homomorphic
+`Ciphertext` (:38-41, ops :219-283), and the hybrid scheme used to
+deliver shares — ElGamal KEM to a symmetric point, Blake2b KDF to a
+ChaCha20 key+nonce, stream-cipher DEM (:45-50, :134-193).
+
+KEM scalar-mults are the device-batched hot half (SURVEY §2 table);
+this module is the host oracle + per-message cold path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+
+from ..groups.host import HostGroup
+from .chacha import chacha20_xor
+
+
+@dataclass(frozen=True)
+class Keypair:
+    """sk, pk = g*sk (reference: elgamal.rs:52-80)."""
+
+    sk: int
+    pk: tuple
+
+    @classmethod
+    def generate(cls, group: HostGroup, rng) -> "Keypair":
+        sk = group.random_scalar(rng)
+        return cls(sk, group.scalar_mul(sk, group.generator()))
+
+    @classmethod
+    def from_secret(cls, group: HostGroup, sk: int) -> "Keypair":
+        return cls(sk, group.scalar_mul(sk, group.generator()))
+
+
+@dataclass(frozen=True)
+class Ciphertext:
+    """Lifted-ElGamal ciphertext (e1, e2) = (r*G, m*G + r*PK)
+    (reference: elgamal.rs:38-41)."""
+
+    e1: tuple
+    e2: tuple
+
+    def add(self, group: HostGroup, other: "Ciphertext") -> "Ciphertext":
+        """Homomorphic sum (reference: elgamal.rs:219-234)."""
+        return Ciphertext(group.add(self.e1, other.e1), group.add(self.e2, other.e2))
+
+    def sub(self, group: HostGroup, other: "Ciphertext") -> "Ciphertext":
+        return Ciphertext(group.sub(self.e1, other.e1), group.sub(self.e2, other.e2))
+
+    def mul_scalar(self, group: HostGroup, k: int) -> "Ciphertext":
+        """Homomorphic scalar mult (reference: elgamal.rs:260-283)."""
+        return Ciphertext(group.scalar_mul(k, self.e1), group.scalar_mul(k, self.e2))
+
+
+def encrypt_point(group: HostGroup, pk: tuple, m_point: tuple, rng) -> Ciphertext:
+    """ElGamal on a group element (reference: elgamal.rs:97-105)."""
+    r = group.random_scalar(rng)
+    return encrypt_point_with_random(group, pk, m_point, r)
+
+
+def encrypt_point_with_random(
+    group: HostGroup, pk: tuple, m_point: tuple, r: int
+) -> Ciphertext:
+    e1 = group.scalar_mul(r, group.generator())
+    e2 = group.add(m_point, group.scalar_mul(r, pk))
+    return Ciphertext(e1, e2)
+
+
+def encrypt(group: HostGroup, pk: tuple, m: int, rng) -> Ciphertext:
+    """Lifted ElGamal: encrypts m*G (reference: elgamal.rs:107-115)."""
+    return encrypt_point(group, pk, group.scalar_mul(m, group.generator()), rng)
+
+
+def decrypt_point(group: HostGroup, sk: int, c: Ciphertext) -> tuple:
+    """m*G = e2 - sk*e1 (reference: elgamal.rs:157-159)."""
+    return group.sub(c.e2, group.scalar_mul(sk, c.e1))
+
+
+# ---------------------------------------------------------------------------
+# hybrid encryption (the share-delivery scheme, lib.rs:1-6)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class HybridCiphertext:
+    """(e1 = r*G, ChaCha20-encrypted payload) (reference: elgamal.rs:45-50)."""
+
+    e1: tuple
+    ciphertext: bytes
+
+
+@dataclass(frozen=True)
+class SymmetricKey:
+    """The KEM group element pk*r == sk*e1 (reference: elgamal.rs:56-58)."""
+
+    point: tuple
+
+
+def _keystream_params(group: HostGroup, kem_point: tuple) -> tuple[bytes, bytes]:
+    """Blake2b-512(encode(kem_point)) -> (32-byte key, 12-byte nonce)
+    (reference: elgamal.rs:180-193 initialise_encryption)."""
+    digest = hashlib.blake2b(
+        group.encode(kem_point), digest_size=64, person=b"dkgtpu-kdf"
+    ).digest()
+    return digest[:32], digest[32:44]
+
+
+def hybrid_encrypt(group: HostGroup, pk: tuple, message: bytes, rng) -> HybridCiphertext:
+    """KEM: pk*r; DEM: ChaCha20 (reference: elgamal.rs:134-145)."""
+    r = group.random_scalar(rng)
+    return hybrid_encrypt_with_random(group, pk, message, r)
+
+
+def hybrid_encrypt_with_random(
+    group: HostGroup, pk: tuple, message: bytes, r: int
+) -> HybridCiphertext:
+    e1 = group.scalar_mul(r, group.generator())
+    kem = group.scalar_mul(r, pk)
+    key, nonce = _keystream_params(group, kem)
+    return HybridCiphertext(e1, chacha20_xor(key, nonce, message))
+
+
+def recover_symmetric_key(group: HostGroup, sk: int, c: HybridCiphertext) -> SymmetricKey:
+    """sk*e1 (reference: elgamal.rs:161-168)."""
+    return SymmetricKey(group.scalar_mul(sk, c.e1))
+
+
+def hybrid_decrypt_with_key(
+    group: HostGroup, symm: SymmetricKey, c: HybridCiphertext
+) -> bytes:
+    """Decrypt given a disclosed KEM key — the complaint-verification path
+    (reference: elgamal.rs:147-155 + broadcast.rs:244-255)."""
+    key, nonce = _keystream_params(group, symm.point)
+    return chacha20_xor(key, nonce, c.ciphertext)
+
+
+def hybrid_decrypt(group: HostGroup, sk: int, c: HybridCiphertext) -> bytes:
+    return hybrid_decrypt_with_key(group, recover_symmetric_key(group, sk, c), c)

@@ -1,0 +1,8 @@
+"""Secret-sharing polynomial algebra (reference: src/polynomial.rs)."""
+
+from .host import (  # noqa: F401
+    Polynomial,
+    interpolate,
+    lagrange_coefficient,
+    lagrange_interpolation,
+)

@@ -1,0 +1,111 @@
+"""Batched polynomial kernels over limb arrays (JAX, TPU-first).
+
+The DKG hot loops this replaces (SURVEY §2 parallelism table):
+
+* per-recipient share generation — the reference evaluates each dealing
+  polynomial serially per index (reference: committee.rs:163-186 →
+  polynomial.rs:68-74, a powers-of-x dot product).  Here ``eval_many``
+  is one Horner scan batched over (dealers × recipients) at once.
+* index powers (1, i, i^2, ..., i^t) used by share verification
+  (reference: committee.rs:287-290 via traits.rs:172-178 ``exp_iter``)
+  — ``powers`` builds them as one scan, batched over all verifiers.
+* Lagrange reconstruction at zero (reference: polynomial.rs:162-184,
+  committee.rs:784-789) — ``lagrange_at_zero`` with Montgomery-trick
+  batched inversion.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..fields import device as fd
+from ..fields.spec import FieldSpec
+
+
+def eval_many(fs: FieldSpec, coeffs: jax.Array, xs: jax.Array) -> jax.Array:
+    """Evaluate polynomials at many points: Horner over the coeff axis.
+
+    coeffs: (..., T, L) — T = degree+1 coefficients, low-order first.
+    xs:     (..., N, L) — N evaluation points.
+    returns (..., N, L) — values; batch axes broadcast.
+    """
+    # scan MSB-first over coefficients: acc = acc*x + c_k
+    cs_rev = jnp.moveaxis(coeffs, -2, 0)[::-1]  # (T, ..., L)
+
+    def step(acc, c):
+        # acc: (..., N, L); c: (..., L) broadcast over N
+        acc = fd.mul(fs, acc, xs)
+        return fd.add(fs, acc, c[..., None, :]), None
+
+    batch = jnp.broadcast_shapes(coeffs.shape[:-2], xs.shape[:-2])
+    init = fd.zeros(fs, batch + (xs.shape[-2],))
+    acc, _ = lax.scan(step, init, cs_rev)
+    return acc
+
+
+def powers(fs: FieldSpec, x: jax.Array, count: int) -> jax.Array:
+    """(1, x, x^2, ..., x^(count-1)): x (..., L) -> (..., count, L).
+
+    Batched replacement for the reference's ``exp_iter``
+    (reference: src/traits.rs:172-202)."""
+
+    def step(acc, _):
+        nxt = fd.mul(fs, acc, x)
+        return nxt, acc
+
+    init = jnp.broadcast_to(fd.ones(fs), x.shape)
+    _, out = lax.scan(step, init, None, length=count)  # (count, ..., L)
+    return jnp.moveaxis(out, 0, -2)
+
+
+def lagrange_at_zero_coeffs(fs: FieldSpec, xs: jax.Array) -> jax.Array:
+    """Lagrange coefficients lambda_i(0) for nodes xs: (..., M, L) -> same.
+
+    lambda_i(0) = prod_{j!=i} x_j / (x_j - x_i).  Numerators via masked
+    full-product; denominators inverted with one batched Fermat inversion
+    (Montgomery trick in fd.batch_inv).
+    """
+    m = xs.shape[-2]
+    xi = xs[..., :, None, :]  # (..., M, 1, L)
+    xj = xs[..., None, :, :]  # (..., 1, M, L)
+    diff = fd.sub(fs, xj, xi)  # (..., M, M, L): x_j - x_i
+    one = jnp.broadcast_to(fd.ones(fs), diff.shape)
+    eye = jnp.eye(m, dtype=bool)
+    eye = eye.reshape((1,) * (xs.ndim - 2) + (m, m))
+    num_terms = fd.select(jnp.broadcast_to(eye, diff.shape[:-1]), one,
+                          jnp.broadcast_to(xj, diff.shape))
+    den_terms = fd.select(jnp.broadcast_to(eye, diff.shape[:-1]), one, diff)
+
+    def prod_axis(terms):
+        t = jnp.moveaxis(terms, -2, 0)  # (M, ..., M, L)
+
+        def step(acc, v):
+            return fd.mul(fs, acc, v), None
+
+        init = jnp.broadcast_to(fd.ones(fs), t.shape[1:])
+        acc, _ = lax.scan(step, init, t)
+        return acc
+
+    nums = prod_axis(num_terms)  # (..., M, L)
+    dens = prod_axis(den_terms)  # (..., M, L)
+    return fd.mul(fs, nums, fd.batch_inv(fs, dens, axis=-2))
+
+
+def lagrange_at_zero(fs: FieldSpec, xs: jax.Array, ys: jax.Array) -> jax.Array:
+    """Interpolate through (xs, ys) and evaluate at 0: (..., M, L) -> (..., L).
+
+    The reconstruction step of the protocol (reference:
+    committee.rs:784-789 → polynomial.rs:172-184), batched over leading
+    axes (many reconstructed parties at once).
+    """
+    lam = lagrange_at_zero_coeffs(fs, xs)
+    terms = fd.mul(fs, lam, ys)  # (..., M, L)
+    t = jnp.moveaxis(terms, -2, 0)
+
+    def step(acc, v):
+        return fd.add(fs, acc, v), None
+
+    acc, _ = lax.scan(step, fd.zeros(fs, terms.shape[:-2]), t)
+    return acc

@@ -1,0 +1,101 @@
+"""Polynomial layer: host semantics (vs hand-computed + reference-style
+oracles, reference: src/polynomial.rs:186-280) and device/host parity."""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dkg_tpu.fields import L25519, SECP256K1_N, host as fh
+from dkg_tpu.poly import (
+    Polynomial,
+    interpolate,
+    lagrange_coefficient,
+    lagrange_interpolation,
+)
+from dkg_tpu.poly import device as pd
+
+RNG = random.Random(0x901)
+
+FIELDS = [L25519, SECP256K1_N]
+FIELD_IDS = [fs.name for fs in FIELDS]
+
+
+def test_evaluate_known():
+    # f(x) = 3 + 2x + x^2 (mirrors reference poly_tests style)
+    f = Polynomial.from_ints(L25519, [3, 2, 1])
+    assert f.evaluate(0) == 3
+    assert f.evaluate(1) == 6
+    assert f.evaluate(2) == 11
+    assert f.at_zero() == 3
+
+
+def test_add_mul_known():
+    fs = L25519
+    a = Polynomial.from_ints(fs, [1, 2])
+    b = Polynomial.from_ints(fs, [3, 4, 5])
+    assert (a + b).coeffs == (4, 6, 5)
+    # (1+2x)(3+4x+5x^2) = 3 + 10x + 13x^2 + 10x^3
+    assert (a * b).coeffs == (3, 10, 13, 10)
+
+
+@pytest.mark.parametrize("fs", FIELDS, ids=FIELD_IDS)
+def test_lagrange_roundtrip(fs):
+    deg = 5
+    f = Polynomial.random(fs, deg, RNG)
+    xs = [1, 2, 3, 5, 8, 13]
+    ys = [f.evaluate(x) for x in xs]
+    # scalar interpolation recovers f at arbitrary points incl. 0
+    assert lagrange_interpolation(fs, 0, ys, xs) == f.at_zero()
+    assert lagrange_interpolation(fs, 77, ys, xs) == f.evaluate(77)
+    # full interpolation recovers the coefficients
+    assert interpolate(fs, xs, ys).coeffs == f.coeffs
+
+
+def test_lagrange_coefficients_sum_to_one():
+    fs = L25519
+    xs = [1, 4, 9, 11]
+    total = sum(lagrange_coefficient(fs, 0, i, xs) for i in range(len(xs)))
+    assert total % fs.modulus == 1
+
+
+@pytest.mark.parametrize("fs", FIELDS, ids=FIELD_IDS)
+def test_eval_many_parity(fs):
+    dealers, t, n = 3, 4, 6
+    polys = [Polynomial.random(fs, t, RNG) for _ in range(dealers)]
+    xs = list(range(1, n + 1))
+    dcoeffs = jnp.asarray(fh.encode(fs, [list(p.coeffs) for p in polys]))
+    dxs = jnp.asarray(fh.encode(fs, xs))  # (n, L) shared across dealers
+    got = np.asarray(pd.eval_many(fs, dcoeffs, dxs))  # (dealers, n, L)
+    for d in range(dealers):
+        for j, x in enumerate(xs):
+            assert fh.decode_int(fs, got[d, j]) == polys[d].evaluate(x)
+
+
+@pytest.mark.parametrize("fs", FIELDS, ids=FIELD_IDS)
+def test_powers_parity(fs):
+    xs = [2, 7, fs.modulus - 1]
+    dx = jnp.asarray(fh.encode(fs, xs))
+    got = np.asarray(pd.powers(fs, dx, 6))  # (3, 6, L)
+    for i, x in enumerate(xs):
+        for k in range(6):
+            assert fh.decode_int(fs, got[i, k]) == pow(x, k, fs.modulus)
+
+
+@pytest.mark.parametrize("fs", FIELDS, ids=FIELD_IDS)
+def test_lagrange_at_zero_parity(fs):
+    t = 4
+    f = Polynomial.random(fs, t, RNG)
+    xs = [2, 3, 5, 7, 11]
+    ys = [f.evaluate(x) for x in xs]
+    dxs = jnp.asarray(fh.encode(fs, xs))
+    dys = jnp.asarray(fh.encode(fs, ys))
+    got = pd.lagrange_at_zero(fs, dxs, dys)
+    assert fh.decode_int(fs, np.asarray(got)) == f.at_zero()
+    # batched: two reconstructions at once
+    got2 = pd.lagrange_at_zero(
+        fs, jnp.stack([dxs, dxs]), jnp.stack([dys, dys])
+    )
+    assert fh.decode_int(fs, np.asarray(got2)[1]) == f.at_zero()
