@@ -1,0 +1,44 @@
+"""Protocol error taxonomy (reference: src/errors.rs:4-74).
+
+Errors are *returned*, not raised, by phase transitions: a party whose own
+transition fails may still have complaint data to broadcast (reference
+design note src/lib.rs:17-22), so transitions yield
+``(result | DkgError, broadcast | None)``.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+
+class DkgErrorKind(enum.Enum):
+    # (reference: errors.rs:13-68)
+    SHARE_VALIDITY_FAILED = "share validity check failed"
+    FETCHED_INVALID_DATA = "fetched data addressed to a different recipient"
+    SCALAR_OUT_OF_BOUNDS = "decrypted share is not a canonical scalar"
+    MISBEHAVIOUR_HIGHER_THRESHOLD = "more misbehaving parties than threshold"
+    NOT_ENOUGH_MEMBERS = "fewer honest members than threshold requires"
+    INSUFFICIENT_SHARES_FOR_RECOVERY = "not enough disclosed shares to recover"
+    INVALID_PROOF_OF_MISBEHAVIOUR = "proof of misbehaviour failed to verify"
+    DUPLICATE_SENDER = "two broadcasts claim the same sender index"
+
+
+@dataclass(frozen=True)
+class DkgError(Exception):
+    kind: DkgErrorKind
+    # index the error refers to, when meaningful (reference: errors.rs:42
+    # InsufficientSharesForRecovery carries the failed party index)
+    index: int | None = None
+    detail: str = field(default="")
+
+    def __str__(self) -> str:  # pragma: no cover
+        where = f" (party {self.index})" if self.index is not None else ""
+        return f"{self.kind.value}{where}{': ' + self.detail if self.detail else ''}"
+
+
+@dataclass(frozen=True)
+class ProofError(Exception):
+    """ZKP verification failure (reference: errors.rs:4-8)."""
+
+    detail: str = ""
