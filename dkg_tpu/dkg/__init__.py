@@ -1,0 +1,37 @@
+"""DKG protocol layer (reference: src/dkg/)."""
+
+from .broadcast import (  # noqa: F401
+    BroadcastPhase1,
+    BroadcastPhase2,
+    BroadcastPhase3,
+    BroadcastPhase4,
+    BroadcastPhase5,
+    DisclosedShare,
+    EncryptedShares,
+    MisbehavingPartiesRound1,
+    MisbehavingPartiesRound3,
+    ProofOfMisbehaviour,
+)
+from .committee import (  # noqa: F401
+    DistributedKeyGeneration,
+    DkgPhase1,
+    DkgPhase2,
+    DkgPhase3,
+    DkgPhase4,
+    DkgPhase5,
+    Environment,
+    FetchedComplaints2,
+    FetchedComplaints4,
+    FetchedPhase1,
+    FetchedPhase3,
+    FetchedPhase5,
+)
+from .errors import DkgError, DkgErrorKind, ProofError  # noqa: F401
+from .procedure_keys import (  # noqa: F401
+    MasterPublicKey,
+    MemberCommunicationKey,
+    MemberCommunicationPublicKey,
+    MemberPublicShare,
+    MemberSecretShare,
+    sort_committee,
+)

@@ -1,0 +1,535 @@
+"""GJKR-style DKG committee state machine (phases 1-5).
+
+Functional parity with the reference's typestate protocol driver
+(reference: src/dkg/committee.rs, the crate's heart): dealing (init,
+:124-216), share verification (Phase1::proceed, :260-366), qualified-set
+computation + bare commitments (Phase2::proceed, :369-476), commitment
+re-verification (Phase3::proceed, :508-581), complaint adjudication +
+share disclosure (Phase4::proceed, :625-688), and master-key assembly
+with Lagrange reconstruction (Phase5::finalise, :726-805).
+
+Rust's compile-time typestate becomes runtime phase objects here: each
+phase class exposes exactly one ``proceed``/``finalise`` and transitions
+return ``(next_phase_or_DkgError, broadcast_or_None)`` — errors are
+values, not exceptions, because a failing party may still have complaint
+data to publish (reference: src/lib.rs:17-22, committee.rs:340-347).
+
+Deliberate fixes of reference quirks (SURVEY §5, decided not copied):
+* quirk 1 — the phase-2 threshold check counts *actually qualified*
+  members (the reference compares the constant-length qualified vec,
+  committee.rs:443, which can never fire).
+* quirk 3 — reconstruction requires >= t+1 disclosed points (degree-t
+  polynomial; the reference accepts t, committee.rs:779).
+* quirk 5 — ``init`` verifies the caller-supplied index matches the
+  sorted-committee position instead of trusting it (committee.rs:123).
+
+The network is the caller's problem, exactly as in the reference: phase
+transitions consume ``Fetched*`` views of other parties' broadcasts
+(reference: committee.rs:812-1023).  In the TPU-sharded engine the same
+seam becomes an ICI allgather (see dkg_tpu.parallel).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..crypto.commitment import CommitmentKey
+from ..groups.host import HostGroup
+from ..poly.host import Polynomial, lagrange_interpolation
+from .broadcast import (
+    BroadcastPhase1,
+    BroadcastPhase2,
+    BroadcastPhase3,
+    BroadcastPhase4,
+    BroadcastPhase5,
+    DisclosedShare,
+    EncryptedShares,
+    MisbehavingPartiesRound1,
+    MisbehavingPartiesRound3,
+    ProofOfMisbehaviour,
+    check_bare_share,
+    check_randomized_share,
+)
+from .errors import DkgError, DkgErrorKind
+from .procedure_keys import (
+    MasterPublicKey,
+    MemberCommunicationKey,
+    MemberCommunicationPublicKey,
+    MemberPublicShare,
+    MemberSecretShare,
+    decrypt_shares,
+    sort_committee,
+)
+
+
+@dataclass(frozen=True)
+class Environment:
+    """Ceremony parameters (reference: committee.rs:24-28, init :72-82)."""
+
+    group: HostGroup
+    threshold: int
+    nr_members: int
+    commitment_key: CommitmentKey
+
+    @classmethod
+    def init(
+        cls, group: HostGroup, threshold: int, nr_members: int, shared_string: bytes
+    ) -> "Environment":
+        if threshold < 1 or nr_members < 1:
+            raise ValueError("threshold and committee size must be positive")
+        # honest majority: t < (n+1)/2  (reference assert, committee.rs:79)
+        if not threshold < (nr_members + 1) / 2:
+            raise ValueError("threshold must satisfy t < (n+1)/2")
+        return cls(
+            group, threshold, nr_members, CommitmentKey.generate(group, shared_string)
+        )
+
+
+# ---------------------------------------------------------------------------
+# fetched-broadcast views (reference: committee.rs:812-1023)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class FetchedPhase1:
+    """One counterparty's round-1 message; ``None`` payload == missing or
+    malformed == silent disqualification (reference: committee.rs:825-871,
+    shape checks :844-853)."""
+
+    sender_index: int
+    broadcast: Optional[BroadcastPhase1]
+
+    @classmethod
+    def from_broadcast(
+        cls, env: Environment, sender_index: int, b: Optional[BroadcastPhase1]
+    ) -> "FetchedPhase1":
+        if b is not None and (
+            len(b.committed_coefficients) != env.threshold + 1
+            or len(b.encrypted_shares) != env.nr_members
+        ):
+            b = None
+        return cls(sender_index, b)
+
+
+@dataclass(frozen=True)
+class FetchedComplaints2:
+    """(reference: committee.rs:886-908)"""
+
+    accuser_index: int
+    broadcast: Optional[BroadcastPhase2]
+
+
+@dataclass(frozen=True)
+class FetchedPhase3:
+    """(reference: committee.rs:921-961, shape check :940-946)"""
+
+    sender_index: int
+    broadcast: Optional[BroadcastPhase3]
+
+    @classmethod
+    def from_broadcast(
+        cls, env: Environment, sender_index: int, b: Optional[BroadcastPhase3]
+    ) -> "FetchedPhase3":
+        if b is not None and len(b.committed_coefficients) != env.threshold + 1:
+            b = None
+        return cls(sender_index, b)
+
+
+@dataclass(frozen=True)
+class FetchedComplaints4:
+    """(reference: committee.rs:1027-1066)"""
+
+    accuser_index: int
+    broadcast: Optional[BroadcastPhase4]
+
+
+@dataclass(frozen=True)
+class FetchedPhase5:
+    """(reference: committee.rs:1001-1023)"""
+
+    sender_index: int
+    broadcast: Optional[BroadcastPhase5]
+
+
+class _State:
+    """Mutable per-party protocol state (reference IndividualState,
+    committee.rs:32-45)."""
+
+    def __init__(
+        self,
+        env: Environment,
+        index: int,
+        comm_key: MemberCommunicationKey,
+        members_pks: list[MemberCommunicationPublicKey],
+    ):
+        self.env = env
+        self.index = index  # 1-based position in the sorted committee
+        self.comm_key = comm_key
+        self.members_pks = members_pks
+        # own dealing
+        self.bare_coeff_points: tuple = ()  # A_l = g*a_l
+        self.randomized_coeff_points: tuple = ()  # E_l = g*a_l + h*b_l
+        # per-sender data accumulated across rounds (1-based keys)
+        self.received_shares: dict[int, tuple[int, int]] = {}
+        self.randomized_coeffs: dict[int, tuple] = {}
+        self.bare_coeffs: dict[int, tuple] = {}
+        self.qualified: list[int] = [1] * env.nr_members
+        self.reconstructable: set[int] = set()
+        self.phase3_accused: set[int] = set()
+        self.final_share: Optional[int] = None
+        self.public_share: Optional[tuple] = None
+
+    @property
+    def group(self) -> HostGroup:
+        return self.env.group
+
+    def qualified_count(self) -> int:
+        return sum(self.qualified)
+
+    def disqualify(self, index: int) -> None:
+        self.qualified[index - 1] = 0
+
+
+class DistributedKeyGeneration:
+    """Entry point: run round-1 dealing and obtain Phase1
+    (reference: committee.rs:124-216)."""
+
+    @staticmethod
+    def init(
+        env: Environment,
+        rng,
+        comm_key: MemberCommunicationKey,
+        committee_pks: list[MemberCommunicationPublicKey],
+        my: int,
+    ) -> tuple["DkgPhase1", BroadcastPhase1]:
+        group = env.group
+        if len(committee_pks) != env.nr_members:
+            raise ValueError("committee size does not match environment")
+        pks = sort_committee(group, committee_pks)
+        # verify (not trust) the claimed index — fix of SURVEY §5 quirk 5
+        if not group.eq(pks[my - 1].point, comm_key.public().point):
+            raise ValueError("`my` does not match this key's sorted position")
+
+        state = _State(env, my, comm_key, pks)
+        t = env.threshold
+        fs = group.scalar_field
+
+        sharing = Polynomial.random(fs, t, rng)  # f   (committee.rs:143-146)
+        hiding = Polynomial.random(fs, t, rng)  # f'
+
+        # hot loop #1 (committee.rs:151-159): coefficient commitments
+        bare, randomized = [], []
+        for a_l, b_l in zip(sharing.coeffs, hiding.coeffs):
+            apub = group.scalar_mul(a_l, group.generator())
+            bare.append(apub)
+            randomized.append(group.add(group.scalar_mul(b_l, env.commitment_key.h), apub))
+        state.bare_coeff_points = tuple(bare)
+        state.randomized_coeff_points = tuple(randomized)
+        state.randomized_coeffs[my] = tuple(randomized)
+        state.bare_coeffs[my] = tuple(bare)
+
+        # hot loop #2 (committee.rs:163-186): per-recipient eval + encrypt
+        encrypted = []
+        for i in range(1, env.nr_members + 1):
+            s_i = sharing.evaluate(i)
+            r_i = hiding.evaluate(i)
+            if i == my:
+                state.received_shares[my] = (s_i, r_i)
+            pk_i = pks[i - 1].point
+            from ..crypto.elgamal import hybrid_encrypt
+
+            encrypted.append(
+                EncryptedShares(
+                    i,
+                    hybrid_encrypt(group, pk_i, group.scalar_to_bytes(s_i), rng),
+                    hybrid_encrypt(group, pk_i, group.scalar_to_bytes(r_i), rng),
+                )
+            )
+
+        broadcast = BroadcastPhase1(tuple(randomized), tuple(encrypted))
+        return DkgPhase1(state), broadcast
+
+
+class DkgPhase1:
+    """Holds round-1 output; ``proceed`` = round-2 share verification
+    (reference: committee.rs:260-366)."""
+
+    def __init__(self, state: _State):
+        self._state = state
+
+    def proceed(
+        self, fetched: list[FetchedPhase1], rng
+    ) -> tuple["DkgPhase2 | DkgError", Optional[BroadcastPhase2]]:
+        st = self._state
+        group, env = st.group, st.env
+        complaints: list[MisbehavingPartiesRound1] = []
+
+        for f in fetched:
+            j = f.sender_index
+            if j == st.index:
+                continue
+            if f.broadcast is None:
+                st.disqualify(j)  # silent dropout (committee.rs:332-337)
+                continue
+            mine = f.broadcast.shares_for(st.index)
+            if mine is None or mine.recipient_index != st.index:
+                # caller handed us data not addressed to us
+                return (
+                    DkgError(DkgErrorKind.FETCHED_INVALID_DATA, index=j),
+                    None,
+                )
+            s, r = decrypt_shares(group, st.comm_key, mine.share_ct, mine.randomness_ct)
+            if s is None or r is None:
+                # undecodable scalar -> complaint (committee.rs:318-331)
+                st.disqualify(j)
+                complaints.append(
+                    MisbehavingPartiesRound1(
+                        j,
+                        DkgErrorKind.SCALAR_OUT_OF_BOUNDS,
+                        ProofOfMisbehaviour.generate(group, mine, st.comm_key, rng),
+                    )
+                )
+                continue
+            coeffs = f.broadcast.committed_coefficients
+            if not check_randomized_share(
+                group, env.commitment_key, st.index, s, r, coeffs
+            ):
+                # invalid share -> complaint w/ evidence (committee.rs:305-317)
+                st.disqualify(j)
+                complaints.append(
+                    MisbehavingPartiesRound1(
+                        j,
+                        DkgErrorKind.SHARE_VALIDITY_FAILED,
+                        ProofOfMisbehaviour.generate(group, mine, st.comm_key, rng),
+                    )
+                )
+                continue
+            st.received_shares[j] = (s, r)
+            st.randomized_coeffs[j] = tuple(coeffs)
+
+        broadcast = BroadcastPhase2(tuple(complaints)) if complaints else None
+        if len(complaints) > env.threshold:
+            # abort but still publish evidence (committee.rs:340-347)
+            return (
+                DkgError(DkgErrorKind.MISBEHAVIOUR_HIGHER_THRESHOLD),
+                broadcast,
+            )
+        return DkgPhase2(st), broadcast
+
+
+class DkgPhase2:
+    """``proceed`` = round-3: adjudicate round-2 complaints into the
+    qualified set, aggregate the final share, publish bare commitments
+    (reference: committee.rs:369-476)."""
+
+    def __init__(self, state: _State):
+        self._state = state
+
+    def proceed(
+        self,
+        complaints: list[FetchedComplaints2],
+        round1_broadcasts: list[FetchedPhase1],
+    ) -> tuple["DkgPhase3 | DkgError", Optional[BroadcastPhase3]]:
+        st = self._state
+        group, env = st.group, st.env
+        by_sender = {f.sender_index: f.broadcast for f in round1_broadcasts}
+
+        # compute_qualified_set (committee.rs:369-398): one upheld
+        # complaint disqualifies the accused.
+        for fc in complaints:
+            if fc.broadcast is None:
+                continue
+            accuser_pk = st.members_pks[fc.accuser_index - 1]
+            for m in fc.broadcast.misbehaving_parties:
+                accused_b = by_sender.get(m.accused_index)
+                if accused_b is None:
+                    # accused never dealt; already disqualified by silence
+                    st.disqualify(m.accused_index)
+                    continue
+                if m.verify(
+                    group, env.commitment_key, fc.accuser_index, accuser_pk, accused_b
+                ):
+                    st.disqualify(m.accused_index)
+
+        # threshold check on the *actual* qualified count — fix of
+        # SURVEY §5 quirk 1 (reference's check, committee.rs:443, is dead)
+        if st.qualified_count() < env.threshold + 1:
+            return DkgError(DkgErrorKind.NOT_ENOUGH_MEMBERS), None
+
+        # final share = sum of qualified dealers' shares (committee.rs:453-467)
+        fs_mod = group.scalar_field.modulus
+        total = 0
+        for j in range(1, env.nr_members + 1):
+            if st.qualified[j - 1] and j in st.received_shares:
+                total = (total + st.received_shares[j][0]) % fs_mod
+        st.final_share = total
+        st.public_share = group.scalar_mul(total, group.generator())
+
+        # publish the bare coefficient commitments A_l (committee.rs:447-451)
+        return DkgPhase3(st), BroadcastPhase3(st.bare_coeff_points)
+
+
+class DkgPhase3:
+    """``proceed`` = round-4: re-verify shares against the bare
+    commitments (reference: committee.rs:508-581)."""
+
+    def __init__(self, state: _State):
+        self._state = state
+
+    def proceed(
+        self, fetched: list[FetchedPhase3]
+    ) -> tuple["DkgPhase4 | DkgError", Optional[BroadcastPhase4]]:
+        st = self._state
+        group = st.group
+        complaints: list[MisbehavingPartiesRound3] = []
+        by_sender = {f.sender_index: f.broadcast for f in fetched}
+
+        for j in range(1, st.env.nr_members + 1):
+            if j == st.index or not st.qualified[j - 1]:
+                continue
+            if j not in st.received_shares:
+                continue
+            s, r = st.received_shares[j]
+            b = by_sender.get(j)
+            if b is None:
+                # qualified party went silent -> disclose their share
+                # (committee.rs:541-557; full scenario committee.rs:1316-1516)
+                complaints.append(MisbehavingPartiesRound3(j, s, r))
+                st.phase3_accused.add(j)
+                continue
+            coeffs = b.committed_coefficients
+            st.bare_coeffs[j] = tuple(coeffs)
+            if not check_bare_share(group, st.index, s, coeffs):
+                complaints.append(MisbehavingPartiesRound3(j, s, r))
+                st.phase3_accused.add(j)
+
+        honest = st.qualified_count() - len(st.phase3_accused)
+        if honest < st.env.threshold + 1:
+            return (
+                DkgError(DkgErrorKind.NOT_ENOUGH_MEMBERS),
+                BroadcastPhase4(tuple(complaints)) if complaints else None,
+            )
+        broadcast = BroadcastPhase4(tuple(complaints)) if complaints else None
+        return DkgPhase4(st), broadcast
+
+
+class DkgPhase4:
+    """``proceed`` = round-5: adjudicate round-4 complaints; mark upheld
+    accusations for reconstruction and disclose held shares
+    (reference: committee.rs:625-688)."""
+
+    def __init__(self, state: _State):
+        self._state = state
+
+    def proceed(
+        self, complaints: list[FetchedComplaints4]
+    ) -> tuple["DkgPhase5 | DkgError", Optional[BroadcastPhase5]]:
+        st = self._state
+        group, env = st.group, st.env
+
+        for fc in complaints:
+            if fc.broadcast is None:
+                continue
+            for m in fc.broadcast.misbehaving_parties:
+                j = m.accused_index
+                if not st.qualified[j - 1]:
+                    continue
+                randomized = st.randomized_coeffs.get(j)
+                if randomized is None:
+                    continue
+                bare = st.bare_coeffs.get(j)
+                if m.verify(
+                    group,
+                    env.commitment_key,
+                    fc.accuser_index,
+                    randomized,
+                    bare,
+                ):
+                    # two-MSM adjudication (broadcast.rs:111-143): the
+                    # accused stays in the final key but their secret is
+                    # reconstructed by survivors (committee.rs:662-669)
+                    st.reconstructable.add(j)
+
+        st.reconstructable |= st.phase3_accused
+
+        honest = st.qualified_count() - len(st.reconstructable)
+        if honest < env.threshold + 1:
+            return DkgError(DkgErrorKind.NOT_ENOUGH_MEMBERS), None
+
+        disclosures = tuple(
+            DisclosedShare(j, st.index, st.received_shares[j][0])
+            for j in sorted(st.reconstructable)
+            if j in st.received_shares
+        )
+        broadcast = BroadcastPhase5(disclosures) if disclosures else None
+        return DkgPhase5(st), broadcast
+
+
+class DkgPhase5:
+    """``finalise`` = master-key assembly with Lagrange reconstruction of
+    reconstructable parties' secrets (reference: committee.rs:726-805)."""
+
+    def __init__(self, state: _State):
+        self._state = state
+
+    def finalise(
+        self, fetched: list[FetchedPhase5]
+    ) -> tuple[tuple[MasterPublicKey, MemberSecretShare] | DkgError, None]:
+        st = self._state
+        group, env = st.group, st.env
+        fs = group.scalar_field
+
+        # gather disclosed shares: accused -> {holder_index: share}
+        points: dict[int, dict[int, int]] = {j: {} for j in st.reconstructable}
+        for j in st.reconstructable:
+            if j in st.received_shares:
+                points[j][st.index] = st.received_shares[j][0]
+        for f in fetched:
+            if f.broadcast is None:
+                continue
+            for d in f.broadcast.disclosed_shares:
+                if d.accused_index in points:
+                    points[d.accused_index][d.holder_index] = d.share
+
+        master = group.identity()
+        for j in range(1, env.nr_members + 1):
+            if not st.qualified[j - 1]:
+                continue
+            if j in st.reconstructable:
+                xs = sorted(points[j])
+                ys = [points[j][x] for x in xs]
+                # need >= t+1 points for a degree-t polynomial — fix of
+                # SURVEY §5 quirk 3 (reference requires only t, :779)
+                if len(xs) < env.threshold + 1:
+                    return (
+                        DkgError(
+                            DkgErrorKind.INSUFFICIENT_SHARES_FOR_RECOVERY, index=j
+                        ),
+                        None,
+                    )
+                recovered = lagrange_interpolation(fs, 0, ys, xs)
+                master = group.add(
+                    master, group.scalar_mul(recovered, group.generator())
+                )
+            else:
+                coeffs = st.bare_coeffs.get(j)
+                if coeffs is None:
+                    return (
+                        DkgError(DkgErrorKind.NOT_ENOUGH_MEMBERS, index=j),
+                        None,
+                    )
+                # master += A_{j,0} = g*a_{j,0} (committee.rs:791-796)
+                master = group.add(master, coeffs[0])
+
+        assert st.final_share is not None
+        return (MasterPublicKey(master), MemberSecretShare(st.final_share)), None
+
+    # convenience accessors (reference exposes these on the state)
+    @property
+    def public_share(self) -> MemberPublicShare:
+        return MemberPublicShare(self._state.public_share)
+
+    @property
+    def qualified_set(self) -> list[int]:
+        return list(self._state.qualified)

@@ -1,0 +1,109 @@
+"""The five key roles of the DKG procedure + canonical committee order.
+
+Functional parity with the reference (reference:
+src/dkg/procedure_keys.rs): `MemberSecretShare` (:10),
+`MemberPublicShare` (:14), `MemberCommunicationKey` (:19),
+`MemberCommunicationPublicKey` (:24), `MasterPublicKey` (:50),
+byte-lexicographic ordering of communication public keys (:26-46),
+share decryption (:88-103), and master-key assembly (:121-129).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..crypto.elgamal import (
+    HybridCiphertext,
+    Keypair,
+    hybrid_decrypt,
+)
+from ..groups.host import HostGroup
+
+
+@dataclass(frozen=True)
+class MemberSecretShare:
+    """The party's final secret share x_i (reference: procedure_keys.rs:10)."""
+
+    value: int
+
+
+@dataclass(frozen=True)
+class MemberPublicShare:
+    """g * x_i (reference: procedure_keys.rs:14)."""
+
+    point: tuple
+
+
+@dataclass(frozen=True)
+class MemberCommunicationKey:
+    """Long-term communication keypair used for share delivery
+    (reference: procedure_keys.rs:19-22)."""
+
+    keypair: Keypair
+
+    @classmethod
+    def generate(cls, group: HostGroup, rng) -> "MemberCommunicationKey":
+        return cls(Keypair.generate(group, rng))
+
+    @property
+    def sk(self) -> int:
+        return self.keypair.sk
+
+    def public(self) -> "MemberCommunicationPublicKey":
+        return MemberCommunicationPublicKey(self.keypair.pk)
+
+
+@dataclass(frozen=True)
+class MemberCommunicationPublicKey:
+    point: tuple
+
+    def sort_key(self, group: HostGroup) -> bytes:
+        """Canonical committee order = byte-lexicographic on the encoded
+        pk (reference: procedure_keys.rs:26-46)."""
+        return group.encode(self.point)
+
+
+def sort_committee(
+    group: HostGroup, pks: list[MemberCommunicationPublicKey]
+) -> list[MemberCommunicationPublicKey]:
+    """Sorted committee; all parties derive identical indexing
+    (reference: committee.rs:134-135)."""
+    return sorted(pks, key=lambda k: k.sort_key(group))
+
+
+def decrypt_shares(
+    group: HostGroup,
+    sk: MemberCommunicationKey,
+    share_ct: HybridCiphertext,
+    randomness_ct: HybridCiphertext,
+) -> tuple[Optional[int], Optional[int]]:
+    """Decrypt the (share, commitment-randomness) pair addressed to us;
+    ``None`` entries signal non-canonical scalars (reference:
+    procedure_keys.rs:88-103 -> ScalarOutOfBounds handling
+    committee.rs:318-331)."""
+    fs = group.scalar_field
+    pt1 = hybrid_decrypt(group, sk.sk, share_ct)
+    pt2 = hybrid_decrypt(group, sk.sk, randomness_ct)
+    s = int.from_bytes(pt1, "little") if len(pt1) == fs.nbytes else None
+    r = int.from_bytes(pt2, "little") if len(pt2) == fs.nbytes else None
+    if s is not None and s >= fs.modulus:
+        s = None
+    if r is not None and r >= fs.modulus:
+        r = None
+    return s, r
+
+
+@dataclass(frozen=True)
+class MasterPublicKey:
+    """The ceremony output: sum of qualified parties' public shares
+    (reference: procedure_keys.rs:50, :121-129)."""
+
+    point: tuple
+
+    @classmethod
+    def from_shares(cls, group: HostGroup, shares: list) -> "MasterPublicKey":
+        acc = group.identity()
+        for p in shares:
+            acc = group.add(acc, p.point if isinstance(p, MemberPublicShare) else p)
+        return cls(acc)

@@ -42,6 +42,11 @@ from . import host as gh
 WINDOW = 4  # window bits for scalar decomposition (16-entry tables)
 
 
+def _jit_static0(fn):
+    """jit with the CurveSpec (hashable, frozen) as a static argument."""
+    return jax.jit(fn, static_argnums=0)
+
+
 @dataclasses.dataclass(frozen=True)
 class CurveSpec:
     """Device-side curve description.  Hashable (ints/str only) so it can
@@ -135,18 +140,21 @@ def to_host(cs: CurveSpec, pts: jax.Array) -> list:
 # ---------------------------------------------------------------------------
 
 
+@_jit_static0
 def add(cs: CurveSpec, p: jax.Array, q: jax.Array) -> jax.Array:
     if cs.kind == "edwards":
         return _ed_add(cs, p, q)
     return _ws_add(cs, p, q)
 
 
+@_jit_static0
 def double(cs: CurveSpec, p: jax.Array) -> jax.Array:
     if cs.kind == "edwards":
         return _ed_double(cs, p)
     return _ws_double(cs, p)
 
 
+@_jit_static0
 def neg(cs: CurveSpec, p: jax.Array) -> jax.Array:
     f = cs.field
     if cs.kind == "edwards":
@@ -253,6 +261,7 @@ def _ws_double(cs: CurveSpec, p: jax.Array) -> jax.Array:
     return _stack(x3, y3, z3)
 
 
+@_jit_static0
 def eq(cs: CurveSpec, p: jax.Array, q: jax.Array) -> jax.Array:
     """Batched projective equality -> bool array over the batch shape.
 
@@ -317,6 +326,7 @@ def _gather_table(table: jax.Array, digit: jax.Array) -> jax.Array:
     return jnp.take_along_axis(table, idx, axis=-3)[..., 0, :, :]
 
 
+@_jit_static0
 def scalar_mul(cs: CurveSpec, k: jax.Array, p: jax.Array) -> jax.Array:
     """Batched k·P: k (..., L) scalar limbs, p (..., C, L) points.
 
@@ -406,6 +416,7 @@ def fixed_base_table(cs: CurveSpec, base) -> jax.Array:
     return jnp.asarray(_fixed_table_np(cs, base_key(cs, base)))
 
 
+@_jit_static0
 def fixed_base_mul(cs: CurveSpec, table: jax.Array, k: jax.Array) -> jax.Array:
     """Batched k·B for fixed B: table (NW, 16, C, L), k (..., L).
 
@@ -444,6 +455,7 @@ def _tree_reduce(cs: CurveSpec, pts: jax.Array, axis_len: int) -> jax.Array:
     return pts[..., 0, :, :]
 
 
+@_jit_static0
 def msm(cs: CurveSpec, scalars: jax.Array, points: jax.Array) -> jax.Array:
     """Batched MSM: Σ_j k_j·P_j over axis -2 of scalars / -3 of points.
 
