@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""DKG round-kernel benchmark — prints ONE JSON line.
+
+Workload: the share-verification round, the ceremony's dominant cost
+(SURVEY §6: n·(n-1) size-(t+1) MSM checks in the reference,
+committee.rs:292-296).  Here it is the RLC batch-verify kernel
+(dkg_tpu.dkg.ceremony.verify_batch), which validates all n·(n-1) pair
+relations at once; the reported rate is pair-verifications per second
+on one chip.
+
+vs_baseline: the reference publishes no numbers (BASELINE.md), so the
+ratio is against the driver-defined north star — a full n=4096 ceremony
+in < 10 s on a v5e-8, i.e. 4096^2/10/8 ≈ 209,715 pair-verifies/s/chip.
+value/209715 > 1 means the verification round is on budget.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+NORTH_STAR_RATE_PER_CHIP = 4096 * 4096 / 10.0 / 8.0
+
+
+def timed(fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    return out, time.perf_counter() - t0
+
+
+def run(curve: str, n: int, t: int, rho_bits: int = 128):
+    from dkg_tpu.dkg import ceremony as ce
+
+    rng = random.Random(0xBE7C)
+    c = ce.BatchedCeremony(curve, n, t, b"bench", rng)
+    cfg = c.cfg
+    rho = jnp.asarray(ce.fiat_shamir_rho(cfg, b"bench-rho", rho_bits))
+
+    (a, e, s, r), t_deal = timed(
+        lambda ca, cb: ce.deal(cfg, ca, cb, c.g_table, c.h_table),
+        c.coeffs_a,
+        c.coeffs_b,
+    )
+    ok, t_verify = timed(
+        lambda e_, s_, r_, rho_: ce.verify_batch(
+            cfg, e_, s_, r_, rho_, rho_bits, c.g_table, c.h_table
+        ),
+        e, s, r, rho,
+    )
+    assert bool(jnp.all(ok)), "batch verification failed in bench"
+    return t_deal, t_verify
+
+
+def main():
+    jax.config.update("jax_compilation_cache_dir", "/tmp/dkg_tpu_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    platform = jax.devices()[0].platform
+    # (curve, n, t): north-star curve; size chosen per platform so the
+    # bench finishes promptly.  BASELINE.json config #3 shape on TPU.
+    if platform == "tpu":
+        ladder = [("secp256k1", 1024, 341), ("secp256k1", 256, 85)]
+    else:
+        ladder = [("secp256k1", 64, 21)]
+
+    for curve, n, t in ladder:
+        try:
+            t_deal, t_verify = run(curve, n, t)
+            pairs = n * (n - 1)
+            rate = pairs / t_verify
+            print(
+                json.dumps(
+                    {
+                        "metric": "share_verify_pairs_per_sec_per_chip",
+                        "value": round(rate, 1),
+                        "unit": "pair-verifications/s",
+                        "vs_baseline": round(rate / NORTH_STAR_RATE_PER_CHIP, 4),
+                        "config": {
+                            "curve": curve,
+                            "n": n,
+                            "t": t,
+                            "platform": platform,
+                            "deal_s": round(t_deal, 3),
+                            "verify_s": round(t_verify, 3),
+                        },
+                    }
+                )
+            )
+            return
+        except Exception as exc:  # noqa: BLE001 — fall to smaller config
+            print(f"bench config {curve} n={n} failed: {exc}", file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "metric": "share_verify_pairs_per_sec_per_chip",
+                "value": 0.0,
+                "unit": "pair-verifications/s",
+                "vs_baseline": 0.0,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,311 @@
+"""Batched device ceremony engine: arrays-of-parties as the primitive.
+
+The reference drives one party at a time through the phases and spends
+~all cycles in per-pair scalar ops (SURVEY §3).  This engine inverts the
+shape TPU-first: the ceremony state is struct-of-arrays limb tensors for
+*all parties at once*, and each round is one jitted batched kernel:
+
+* ``deal``   — coefficient commitments A/E for all n dealers' t+1
+  coefficients via fixed-base window tables (reference hot loop #1,
+  committee.rs:151-159), and the full n×n share matrix via one batched
+  Horner scan (hot loop #2, committee.rs:163-186).
+* ``verify_batch`` — random-linear-combination batch verification: with
+  Fiat-Shamir randomizers rho_j, each recipient checks
+
+      g·(sum_j rho_j s_ji) + h·(sum_j rho_j s'_ji)
+          == sum_l x_i^l · (sum_j rho_j E_jl)
+
+  One n-sized point-RLC + one point-Horner per recipient replaces the
+  n·(n-1) individual (t+1)-MSMs of the reference (committee.rs:292-296)
+  — ~100x fewer point-ops at n=4096 — while ``verify_pairwise`` remains
+  for blame assignment when the batch check fails (soundness: a cheating
+  dealer passes the batch check w.p. 2^-rho_bits).
+* ``verify_pairwise`` — the direct per-(recipient, dealer) check, used
+  on the rare failure path and as the parity oracle.
+
+Secrets discipline: coefficients/shares live on device as scalar limb
+arrays; randomness is generated host-side (CSPRNG) and uploaded — the
+device path is branchless/batched so secret-dependent control flow never
+arises (SURVEY §6 hard part d).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..crypto.commitment import CommitmentKey
+from ..fields import device as fd
+from ..fields import host as fh
+from ..groups import device as gd
+from ..groups import host as gh
+from ..poly import device as pdev
+
+
+@dataclasses.dataclass(frozen=True)
+class CeremonyConfig:
+    """Static ceremony shape: hashable, jit-static."""
+
+    curve: str  # name in gd.ALL_CURVES
+    n: int  # committee size
+    t: int  # threshold (polynomial degree)
+
+    @property
+    def cs(self) -> gd.CurveSpec:
+        return gd.ALL_CURVES[self.curve]
+
+    @property
+    def index_bits(self) -> int:
+        """Bit width of party indices 1..n."""
+        return max(int(self.n).bit_length(), 1)
+
+
+# ---------------------------------------------------------------------------
+# round-1 dealing kernels
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def deal(
+    cfg: CeremonyConfig,
+    coeffs_a: jax.Array,  # (n, t+1, L) sharing-poly coefficients (secret)
+    coeffs_b: jax.Array,  # (n, t+1, L) hiding-poly coefficients (secret)
+    g_table: jax.Array,  # (NW, 16, C, L) fixed-base table for g
+    h_table: jax.Array,  # (NW, 16, C, L) fixed-base table for h
+):
+    """All dealers' round-1 outputs in one shot.
+
+    Returns (A, E, s, r):
+      A (n, t+1, C, L) bare commitments g·a_l      (committee.rs:151-159)
+      E (n, t+1, C, L) randomized A + h·b_l
+      s (n, n, L)  share matrix s[j, i] = f_j(i+1)  (committee.rs:163-186)
+      r (n, n, L)  hiding shares f'_j(i+1)
+    """
+    cs = cfg.cs
+    fs = cs.scalar
+    a_pub = gd.fixed_base_mul(cs, g_table, coeffs_a)  # (n, t+1, C, L)
+    b_hid = gd.fixed_base_mul(cs, h_table, coeffs_b)
+    e_comm = gd.add(cs, a_pub, b_hid)
+
+    xs = jnp.arange(1, cfg.n + 1, dtype=jnp.uint32)
+    xs_limbs = jnp.zeros((cfg.n, fs.limbs), jnp.uint32).at[:, 0].set(xs)
+    shares = pdev.eval_many(fs, coeffs_a, xs_limbs)  # (n, n, L)
+    hidings = pdev.eval_many(fs, coeffs_b, xs_limbs)
+    return a_pub, e_comm, shares, hidings
+
+
+# ---------------------------------------------------------------------------
+# verification kernels
+# ---------------------------------------------------------------------------
+
+
+def _field_dot(fs, weights: jax.Array, values: jax.Array) -> jax.Array:
+    """sum_j weights[j] * values[j, ...] over axis 0, mod p.
+
+    weights (m, L), values (m, ..., L) -> (..., L).
+    """
+    prods = fd.mul(fs, weights.reshape((weights.shape[0],) + (1,) * (values.ndim - 2) + (weights.shape[-1],)), values)
+
+    def step(acc, v):
+        return fd.add(fs, acc, v), None
+
+    acc, _ = lax.scan(step, fd.zeros(fs, values.shape[1:-1]), prods)
+    return acc
+
+
+def _point_rlc(cs, weights: jax.Array, points: jax.Array, nbits: int) -> jax.Array:
+    """sum_j weights[j]·P[j, ...] for small (nbits-wide) public weights.
+
+    weights (m,) uint32 limb-0 style... actually (m, L) limbs with only
+    low bits set; points (m, ..., C, L) -> (..., C, L).  Straus binary:
+    nbits rounds of (double + masked tree-add).
+    """
+    # bits (m, nbits) from the 16-bit limbs, then MSB-first rows
+    idx = jnp.arange(nbits)
+    limbs = weights[:, idx // 16]  # (m, nbits)
+    bits = (limbs >> (idx % 16).astype(jnp.uint32)) & 1
+    bits_rev = jnp.moveaxis(bits, -1, 0)[::-1]
+
+    m = points.shape[0]
+
+    def step(acc, bit_row):
+        acc = gd.double(cs, acc)
+        shape = (m,) + (1,) * (points.ndim - 3)
+        sel = gd.select(
+            (bit_row.reshape(shape) != 0) | jnp.zeros(points.shape[:-2], bool),
+            points,
+            gd.identity(cs, points.shape[:-2]),
+        )
+        total = gd._tree_reduce(cs, jnp.moveaxis(sel, 0, -3), m)
+        return gd.add(cs, acc, total), None
+
+    init = gd.identity(cs, points.shape[1:-2])
+    acc, _ = lax.scan(step, init, bits_rev)
+    return acc
+
+
+@functools.partial(jax.jit, static_argnums=(0, 5))
+def verify_batch(
+    cfg: CeremonyConfig,
+    e_comm: jax.Array,  # (n, t+1, C, L) all dealers' randomized commitments
+    shares: jax.Array,  # (n, n, L) s[j, i] as received by recipient i
+    hidings: jax.Array,  # (n, n, L)
+    rho: jax.Array,  # (n, L) Fiat-Shamir randomizers (low rho_bits bits)
+    rho_bits: int,
+    g_table: jax.Array,
+    h_table: jax.Array,
+) -> jax.Array:
+    """RLC batch share-verification; returns (n,) bool per recipient.
+
+    Sound up to 2^-rho_bits per cheating dealer; on False the caller
+    falls back to ``verify_pairwise`` rows for blame assignment
+    (mirrors the complaint path, committee.rs:305-317).
+    """
+    cs = cfg.cs
+    fs = cs.scalar
+
+    # per-recipient scalar RLCs over dealers:  (n_recipients, L)
+    s_rlc = _field_dot(fs, rho, shares)  # sum_j rho_j s_{j,i}
+    r_rlc = _field_dot(fs, rho, hidings)
+
+    # combined commitment columns D_l = sum_j rho_j E_{j,l}: (t+1, C, L)
+    d_comm = _point_rlc(cs, rho, e_comm, rho_bits)
+
+    # RHS_i = sum_l x_i^l D_l via small-x point Horner: (n, C, L)
+    xs = jnp.arange(1, cfg.n + 1, dtype=jnp.uint32)
+    rhs = gd.eval_point_poly(cs, d_comm, xs, cfg.index_bits)
+
+    # LHS_i = g·s_rlc + h·r_rlc
+    lhs = gd.add(
+        cs,
+        gd.fixed_base_mul(cs, g_table, s_rlc),
+        gd.fixed_base_mul(cs, h_table, r_rlc),
+    )
+    return gd.eq(cs, lhs, rhs)
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def verify_pairwise(
+    cfg: CeremonyConfig,
+    e_comm: jax.Array,  # (n_dealers, t+1, C, L)
+    shares: jax.Array,  # (n_dealers, n_recipients, L)
+    hidings: jax.Array,
+    g_table: jax.Array,
+    h_table: jax.Array,
+) -> jax.Array:
+    """Direct per-(dealer, recipient) checks -> (n_dealers, n_recipients)
+    bool.  The reference's equation exactly (committee.rs:292-296), as
+    one wide batched op; used for blame assignment + as parity oracle.
+    """
+    cs = cfg.cs
+    lhs = gd.add(
+        cs,
+        gd.fixed_base_mul(cs, g_table, shares),
+        gd.fixed_base_mul(cs, h_table, hidings),
+    )  # (n_d, n_r, C, L)
+    xs = jnp.arange(1, shares.shape[1] + 1, dtype=jnp.uint32)[None, :]
+    rhs = gd.eval_point_poly(
+        cs, e_comm[:, None], jnp.broadcast_to(xs, shares.shape[:2]), cfg.index_bits
+    )
+    return gd.eq(cs, lhs, rhs)
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def aggregate_shares(cfg: CeremonyConfig, shares: jax.Array, qualified: jax.Array):
+    """Final share per recipient: sum of qualified dealers' shares
+    (committee.rs:453-462).  shares (n_dealers, n_recip, L),
+    qualified (n_dealers,) bool -> (n_recip, L)."""
+    fs = cfg.cs.scalar
+    masked = fd.select(
+        jnp.broadcast_to(qualified[:, None], shares.shape[:-1]),
+        shares,
+        fd.zeros(fs, shares.shape[:-1]),
+    )
+
+    def step(acc, row):
+        return fd.add(fs, acc, row), None
+
+    acc, _ = lax.scan(step, fd.zeros(fs, shares.shape[1:-1]), masked)
+    return acc
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def master_key_from_bare(cfg: CeremonyConfig, a_comm: jax.Array, qualified: jax.Array):
+    """Master public key = sum over qualified dealers of A_{j,0}
+    (committee.rs:791-796).  a_comm (n, t+1, C, L) -> (C, L)."""
+    cs = cfg.cs
+    a0 = a_comm[:, 0]  # (n, C, L)
+    masked = gd.select(
+        jnp.broadcast_to(qualified, a0.shape[:-2]), a0, gd.identity(cs, a0.shape[:-2])
+    )
+    return gd._tree_reduce(cs, masked, masked.shape[0])
+
+
+# ---------------------------------------------------------------------------
+# host-facing orchestration
+# ---------------------------------------------------------------------------
+
+
+def fiat_shamir_rho(cfg: CeremonyConfig, transcript: bytes, rho_bits: int) -> np.ndarray:
+    """Public batch-verification randomizers derived from the round-1
+    transcript (publicly recomputable, so the batch check is itself
+    verifiable).  Returns (n, L) uint32 limbs with rho_bits entropy."""
+    fs = cfg.cs.scalar
+    out = np.zeros((cfg.n, fs.limbs), np.uint32)
+    nbytes = (rho_bits + 7) // 8
+    for j in range(cfg.n):
+        h = hashlib.blake2b(
+            transcript + j.to_bytes(4, "little"), digest_size=nbytes,
+            person=b"dkgtpu-rlc",
+        ).digest()
+        out[j] = fh.encode(fs, int.from_bytes(h, "little"))
+    return out
+
+
+class BatchedCeremony:
+    """Single-host happy-path ceremony over device arrays: deal, batch
+    verify, aggregate, master key.  The complaint path drops to the
+    per-party host state machine (dkg_tpu.dkg.committee) which this
+    engine mirrors kernel-for-equation."""
+
+    def __init__(self, curve: str, n: int, t: int, shared_string: bytes, rng):
+        self.cfg = CeremonyConfig(curve, n, t)
+        cs = self.cfg.cs
+        self.group = gh.ALL_GROUPS[curve]
+        self.ck = CommitmentKey.generate(self.group, shared_string)
+        self.g_table = gd.fixed_base_table(cs, self.group.generator())
+        self.h_table = gd.fixed_base_table(cs, self.ck.h)
+        self.rng = rng
+        fs = cs.scalar
+        self.coeffs_a = jnp.asarray(
+            fh.encode(fs, [[fs.rand_int(rng) for _ in range(t + 1)] for _ in range(n)])
+        )
+        self.coeffs_b = jnp.asarray(
+            fh.encode(fs, [[fs.rand_int(rng) for _ in range(t + 1)] for _ in range(n)])
+        )
+
+    def run(self, rho_bits: int = 128):
+        """Happy-path ceremony; returns dict of device results."""
+        cfg = self.cfg
+        a, e, s, r = deal(cfg, self.coeffs_a, self.coeffs_b, self.g_table, self.h_table)
+        transcript = np.asarray(e).tobytes()[:4096]
+        rho = jnp.asarray(fiat_shamir_rho(cfg, transcript, rho_bits))
+        ok = verify_batch(cfg, e, s, r, rho, rho_bits, self.g_table, self.h_table)
+        qualified = jnp.ones((cfg.n,), bool)
+        final_shares = aggregate_shares(cfg, s, qualified)
+        master = master_key_from_bare(cfg, a, qualified)
+        return {
+            "bare": a,
+            "randomized": e,
+            "shares": s,
+            "hidings": r,
+            "ok": ok,
+            "final_shares": final_shares,
+            "master": master,
+        }

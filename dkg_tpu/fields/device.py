@@ -100,25 +100,34 @@ def cond_sub(x: jax.Array, m) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
+@functools.lru_cache(maxsize=None)
+def _antidiag_onehot(la: int, lb: int, shift: int) -> np.ndarray:
+    """Constant one-hot tensor C[i,j,c] = 1 iff i+j+shift == c, used to
+    collapse the schoolbook product grid into columns with one tensordot
+    (a single XLA contraction instead of 2L unrolled scatter-adds)."""
+    out = np.zeros((la, lb, la + lb), np.uint32)
+    for i in range(la):
+        for j in range(lb):
+            out[i, j, i + j + shift] = 1
+    return out
+
+
 def mul_wide(a: jax.Array, b: jax.Array) -> jax.Array:
     """Full product of limb arrays: (..., La) x (..., Lb) -> (..., La+Lb).
 
     Schoolbook outer product with hi/lo 16-bit split so every column sum
-    stays inside uint32, then one carry scan.  This is the workhorse under
-    every field multiply; XLA fuses the slice-adds into the surrounding
-    elementwise graph.
+    stays inside uint32 (<= 2**21 for L<=24), then one antidiagonal
+    contraction and one carry scan.  This is the workhorse under every
+    field multiply.
     """
     a, b = _u32(a), _u32(b)
     la, lb = a.shape[-1], b.shape[-1]
     prod = a[..., :, None] * b[..., None, :]  # 16x16 -> 32, exact in uint32
     lo = prod & MASK16
     hi = prod >> 16
-    batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
-    out = jnp.zeros(batch + (la + lb,), jnp.uint32)
-    for i in range(la):
-        out = out.at[..., i : i + lb].add(lo[..., i, :])
-        out = out.at[..., i + 1 : i + 1 + lb].add(hi[..., i, :])
-    return normalize(out, la + lb)
+    cols = jnp.tensordot(lo, _antidiag_onehot(la, lb, 0), [[-2, -1], [0, 1]])
+    cols = cols + jnp.tensordot(hi, _antidiag_onehot(la, lb, 1), [[-2, -1], [0, 1]])
+    return normalize(cols, la + lb)
 
 
 # ---------------------------------------------------------------------------

@@ -437,6 +437,61 @@ def fixed_base_mul(cs: CurveSpec, table: jax.Array, k: jax.Array) -> jax.Array:
     return acc
 
 
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def scalar_mul_small(cs: CurveSpec, k: jax.Array, p: jax.Array, nbits: int) -> jax.Array:
+    """k·P for small public integers k < 2**nbits: k (...,) uint32,
+    p (..., C, L) -> (..., C, L).
+
+    Branchless binary ladder, ~2·nbits point-ops — used where scalars are
+    party indices (<= n, so ~14 bits), not full field elements.
+    """
+    bits = (k.astype(jnp.uint32)[..., None] >> jnp.arange(nbits, dtype=jnp.uint32)) & 1
+    bits_rev = jnp.moveaxis(bits, -1, 0)[::-1]  # (nbits, ...) MSB first
+
+    def step(acc, bit):
+        acc = double(cs, acc)
+        return select(bit != 0, add(cs, acc, p), acc), None
+
+    init = identity(cs, p.shape[:-2])
+    acc, _ = lax.scan(step, init, bits_rev)
+    return acc
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def eval_point_poly(
+    cs: CurveSpec, coeffs: jax.Array, x: jax.Array, nbits: int
+) -> jax.Array:
+    """Horner evaluation of a point-coefficient polynomial at small public
+    x: coeffs (..., T, C, L) low-order-first, x (...,) uint32 -> (..., C, L).
+
+    acc = x·acc + C_l per step — the share-verification RHS
+    sum_l x^l E_l (reference: committee.rs:292-296) without any 255-bit
+    MSM: for x = party index (<= n), each Horner step costs one
+    ~nbits-bit ladder instead of a full-width scalar mult.  This is the
+    TPU-native restructuring of the reference's per-pair Pippenger MSM
+    (SURVEY §2 table row 3).
+    """
+    cs_rev = jnp.moveaxis(coeffs, -3, 0)[::-1]  # (T, ..., C, L) high first
+    bits = (x.astype(jnp.uint32)[..., None] >> jnp.arange(nbits, dtype=jnp.uint32)) & 1
+    bits_rev = jnp.moveaxis(bits, -1, 0)[::-1]  # (nbits, ...) MSB first
+
+    def step(acc, c_l):
+        # acc <- x*acc via branchless ladder
+        mul_acc = identity(cs, acc.shape[:-2])
+
+        def ladder(m, bit):
+            m = double(cs, m)
+            return select(bit != 0, add(cs, m, acc), m), None
+
+        mul_acc, _ = lax.scan(ladder, mul_acc, bits_rev)
+        return add(cs, mul_acc, c_l), None
+
+    batch = jnp.broadcast_shapes(coeffs.shape[:-3], x.shape)
+    init = identity(cs, batch)
+    acc, _ = lax.scan(step, init, cs_rev)
+    return acc
+
+
 # ---------------------------------------------------------------------------
 # multi-scalar multiplication (batched Straus)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,115 @@
+"""Multi-chip DKG: participants sharded over a device mesh.
+
+The reference leaves the broadcast channel abstract — callers shuttle
+`Option<BroadcastPhaseN>` arrays between parties (reference:
+committee.rs:825-871, lib.rs:91-92).  On a TPU pod slice that seam maps
+onto XLA collectives over ICI (SURVEY §2 table, §5):
+
+* round-1 "publish commitments, everyone fetches" -> ``all_gather`` of
+  the commitment limb tensors across the party-sharded mesh axis;
+* per-recipient encrypted-share delivery -> ``all_to_all`` of the
+  (dealer, recipient) share matrix (dealer-sharded -> recipient-sharded);
+* master-key assembly -> every shard reduces the gathered bare
+  commitments (or a ``psum``-style tree on point limbs).
+
+Multi-host ceremonies ride the same code: a global mesh over all hosts'
+devices puts DCN under the same collectives, with the external
+blockchain boundary staying host-side exactly like the reference leaves
+it to the caller.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from ..dkg import ceremony as ce
+from ..fields import device as fd
+from ..groups import device as gd
+from jax import lax
+
+PARTY_AXIS = "parties"
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    """1-D mesh over the party axis (v5e-8: 8 shards, 512 parties/shard
+    at n=4096 — SURVEY §2 table row 4)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (PARTY_AXIS,))
+
+
+def sharded_ceremony(
+    cfg: ce.CeremonyConfig,
+    mesh: Mesh,
+    coeffs_a: jax.Array,  # (n, t+1, L) global, sharded on axis 0
+    coeffs_b: jax.Array,
+    g_table: jax.Array,  # replicated
+    h_table: jax.Array,
+    rho: jax.Array,  # (n, L) replicated Fiat-Shamir randomizers
+    rho_bits: int,
+):
+    """Full happy-path ceremony, parties sharded over the mesh.
+
+    Returns (ok, final_shares, master): ok/final_shares sharded by
+    recipient, master replicated.  jit-compiled over the mesh; the
+    driver's ``dryrun_multichip`` runs this on a virtual CPU mesh.
+    """
+    n_dev = mesh.devices.size
+    if cfg.n % n_dev != 0:
+        raise ValueError("committee size must divide evenly over the mesh")
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(PARTY_AXIS), P(PARTY_AXIS), P(), P(), P()),
+        out_specs=(P(PARTY_AXIS), P(PARTY_AXIS), P()),
+        check_rep=False,
+    )
+    def step(ca, cb, gt, ht, rho_all):
+        # --- round 1, local dealing (deal() evaluates at global indices)
+        a, e, s, r = ce.deal(cfg, ca, cb, gt, ht)
+        # --- "broadcast + fetch" = ICI allgather of commitments
+        e_all = lax.all_gather(e, PARTY_AXIS, tiled=True)  # (n, t+1, C, L)
+        a_all = lax.all_gather(a, PARTY_AXIS, tiled=True)
+        # --- share delivery: dealer-sharded -> recipient-sharded
+        s_recv = lax.all_to_all(s, PARTY_AXIS, split_axis=1, concat_axis=0, tiled=True)
+        r_recv = lax.all_to_all(r, PARTY_AXIS, split_axis=1, concat_axis=0, tiled=True)
+        # --- round 2: RLC batch verification of the local recipient block
+        shard = lax.axis_index(PARTY_AXIS)
+        block = cfg.n // n_dev
+        first = shard * block + 1
+        ok = _verify_block(cfg, e_all, s_recv, r_recv, rho_all, rho_bits, gt, ht, first, block)
+        # --- aggregation + master key (all dealers qualified: happy path)
+        qualified = jnp.ones((cfg.n,), bool)
+        finals = ce.aggregate_shares(cfg, s_recv, qualified)
+        master = ce.master_key_from_bare(cfg, a_all, qualified)
+        return ok, finals, master
+
+    return step(coeffs_a, coeffs_b, g_table, h_table, rho)
+
+
+def _verify_block(cfg, e_all, s_recv, r_recv, rho, rho_bits, g_table, h_table, first, block):
+    """RLC batch verification for a block of recipients [first, first+block).
+
+    Same equations as ce.verify_batch but with shard-local recipient
+    indices (the D_l point-RLC is over *all* dealers, gathered)."""
+    cs = cfg.cs
+    fs = cs.scalar
+    s_rlc = ce._field_dot(fs, rho, s_recv)  # (block, L)
+    r_rlc = ce._field_dot(fs, rho, r_recv)
+    d_comm = ce._point_rlc(cs, rho, e_all, rho_bits)  # (t+1, C, L)
+    xs = first + jnp.arange(block, dtype=jnp.uint32)
+    rhs = gd.eval_point_poly(cs, d_comm, xs, cfg.index_bits)
+    lhs = gd.add(
+        cs,
+        gd.fixed_base_mul(cs, g_table, s_rlc),
+        gd.fixed_base_mul(cs, h_table, r_rlc),
+    )
+    return gd.eq(cs, lhs, rhs)

@@ -1,0 +1,140 @@
+"""Batched device ceremony engine vs the host protocol oracle.
+
+The engine's kernels must agree equation-for-equation with the per-party
+host state machine; these tests check dealing commitments, share
+matrices, both verification paths (pairwise + RLC batch), cheat
+detection, aggregation, and the master key, on a small committee.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dkg_tpu.crypto import commitment as cmt
+from dkg_tpu.dkg import ceremony as ce
+from dkg_tpu.fields import host as fh
+from dkg_tpu.groups import device as gd
+from dkg_tpu.groups import host as gh
+from dkg_tpu.poly.host import Polynomial, lagrange_interpolation
+
+RNG = random.Random(0xBA7C4)
+
+N, T = 5, 2
+CURVE = "ristretto255"
+
+
+@pytest.fixture(scope="module")
+def ceremony():
+    c = ce.BatchedCeremony(CURVE, N, T, b"engine-test", RNG)
+    out = c.run(rho_bits=64)
+    return c, out
+
+
+def host_polys(c):
+    fs = c.cfg.cs.scalar
+    a = fh.decode(fs, np.asarray(c.coeffs_a))
+    b = fh.decode(fs, np.asarray(c.coeffs_b))
+    pa = [Polynomial.from_ints(fs, row) for row in a]
+    pb = [Polynomial.from_ints(fs, row) for row in b]
+    return pa, pb
+
+
+def test_deal_matches_host(ceremony):
+    c, out = ceremony
+    g = c.group
+    fs = c.cfg.cs.scalar
+    pa, pb = host_polys(c)
+    bare = np.asarray(out["bare"])
+    rand = np.asarray(out["randomized"])
+    shares = np.asarray(out["shares"])
+    for j in range(N):
+        for l in range(T + 1):
+            a_l, b_l = pa[j].coeffs[l], pb[j].coeffs[l]
+            expect_a = g.scalar_mul(a_l, g.generator())
+            expect_e = g.add(expect_a, g.scalar_mul(b_l, c.ck.h))
+            assert g.eq(gd.to_host(c.cfg.cs, bare[j])[l], expect_a)
+            assert g.eq(gd.to_host(c.cfg.cs, rand[j])[l], expect_e)
+        for i in range(N):
+            assert fh.decode_int(fs, shares[j, i]) == pa[j].evaluate(i + 1)
+
+
+def test_pairwise_verify_all_pass_and_detects_cheat(ceremony):
+    c, out = ceremony
+    cfg = c.cfg
+    ok = ce.verify_pairwise(
+        cfg, out["randomized"], out["shares"], out["hidings"], c.g_table, c.h_table
+    )
+    assert np.asarray(ok).all()
+
+    # corrupt dealer 2's share to recipient 3
+    fs = cfg.cs.scalar
+    bad = np.asarray(out["shares"]).copy()
+    bad[2, 3] = fh.encode(fs, (fh.decode_int(fs, bad[2, 3]) + 1) % fs.modulus)
+    ok2 = np.array(
+        ce.verify_pairwise(
+            cfg, out["randomized"], jnp.asarray(bad), out["hidings"], c.g_table, c.h_table
+        )
+    )
+    assert not ok2[2, 3]
+    ok2[2, 3] = True
+    assert ok2.all()  # only the corrupted pair fails
+
+
+def test_batch_verify_all_pass_and_detects_cheat(ceremony):
+    c, out = ceremony
+    cfg = c.cfg
+    assert np.asarray(out["ok"]).all()
+
+    fs = cfg.cs.scalar
+    bad = np.asarray(out["shares"]).copy()
+    bad[1, 0] = fh.encode(fs, (fh.decode_int(fs, bad[1, 0]) + 5) % fs.modulus)
+    rho = jnp.asarray(ce.fiat_shamir_rho(cfg, b"transcript", 64))
+    ok = np.asarray(
+        ce.verify_batch(
+            cfg, out["randomized"], jnp.asarray(bad), out["hidings"], rho, 64,
+            c.g_table, c.h_table,
+        )
+    )
+    assert not ok[0]  # recipient 0's batch check fails
+    assert ok[1:].all()
+
+
+def test_aggregate_and_master_consistency(ceremony):
+    c, out = ceremony
+    g = c.group
+    cfg = c.cfg
+    fs = cfg.cs.scalar
+    pa, _ = host_polys(c)
+
+    # final shares = column sums of the share matrix
+    finals = [fh.decode_int(fs, row) for row in np.asarray(out["final_shares"])]
+    for i in range(N):
+        expect = sum(p.evaluate(i + 1) for p in pa) % fs.modulus
+        assert finals[i] == expect
+
+    # master key = g * sum of secrets; interpolating t+1 final shares
+    # reproduces it (the reference oracle, committee.rs:1503-1515)
+    master = gd.to_host(cfg.cs, np.asarray(out["master"])[None])[0]
+    secret = sum(p.at_zero() for p in pa) % fs.modulus
+    assert g.eq(master, g.scalar_mul(secret, g.generator()))
+    xs = list(range(1, T + 2))
+    interp = lagrange_interpolation(fs, 0, finals[: T + 1], xs)
+    assert interp == secret
+
+
+def test_master_respects_qualified_mask(ceremony):
+    c, out = ceremony
+    g = c.group
+    cfg = c.cfg
+    fs = cfg.cs.scalar
+    pa, _ = host_polys(c)
+    qualified = jnp.asarray([True, True, False, True, True])
+    master = ce.master_key_from_bare(cfg, out["bare"], qualified)
+    secret = sum(p.at_zero() for j, p in enumerate(pa) if j != 2) % fs.modulus
+    assert g.eq(
+        gd.to_host(cfg.cs, np.asarray(master)[None])[0],
+        g.scalar_mul(secret, g.generator()),
+    )

@@ -1,0 +1,55 @@
+"""Sharded-ceremony tests on the 8-virtual-device CPU mesh (conftest.py
+forces xla_force_host_platform_device_count=8, mirroring the driver's
+multichip dryrun)."""
+
+import random
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from dkg_tpu.dkg import ceremony as ce
+from dkg_tpu.parallel import mesh as pm
+
+RNG = random.Random(0x5A4D)
+
+
+def test_sharded_matches_single_device():
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
+    n, t = 8, 3
+    c = ce.BatchedCeremony("ristretto255", n, t, b"sharded-test", RNG)
+    rho_bits = 64
+    rho = jnp.asarray(ce.fiat_shamir_rho(c.cfg, b"tr", rho_bits))
+
+    # single-device reference
+    a, e, s, r = ce.deal(c.cfg, c.coeffs_a, c.coeffs_b, c.g_table, c.h_table)
+    ok_ref = ce.verify_batch(c.cfg, e, s, r, rho, rho_bits, c.g_table, c.h_table)
+    finals_ref = ce.aggregate_shares(c.cfg, s, jnp.ones((n,), bool))
+    master_ref = ce.master_key_from_bare(c.cfg, a, jnp.ones((n,), bool))
+
+    mesh = pm.make_mesh(8)
+    ok, finals, master = pm.sharded_ceremony(
+        c.cfg, mesh, c.coeffs_a, c.coeffs_b, c.g_table, c.h_table, rho, rho_bits
+    )
+
+    assert np.asarray(ok).all()
+    assert np.asarray(ok_ref).all()
+    # bit-exact parity between sharded and single-device paths
+    np.testing.assert_array_equal(np.asarray(finals), np.asarray(finals_ref))
+    np.testing.assert_array_equal(np.asarray(master), np.asarray(master_ref))
+
+
+def test_mesh_shapes():
+    mesh = pm.make_mesh(8)
+    assert mesh.devices.size == 8
+    # committee size must divide over the mesh
+    c = ce.BatchedCeremony("ristretto255", 6, 2, b"x", RNG)
+    rho = jnp.asarray(ce.fiat_shamir_rho(c.cfg, b"t", 64))
+    try:
+        pm.sharded_ceremony(
+            c.cfg, mesh, c.coeffs_a, c.coeffs_b, c.g_table, c.h_table, rho, 64
+        )
+        assert False, "expected ValueError"
+    except ValueError:
+        pass
