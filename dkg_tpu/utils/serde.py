@@ -1,0 +1,384 @@
+"""Deterministic binary serialization: wire messages + phase snapshots.
+
+The reference has byte codecs only at the scalar/point level
+(reference: traits.rs:162-164, :230-232) and no message or state
+serialization at all (no serde anywhere — SURVEY §5 checkpoint/resume).
+Real ceremonies are asynchronous: parties go away between rounds.  Here
+every broadcast message and the full per-party protocol state are
+serializable, so a party can checkpoint after any phase and resume.
+
+Format: fixed-width little-endian integers, length-prefixed byte
+strings, fixed-size point/scalar encodings from the group backend.  No
+pickle — decoding untrusted bytes must never execute anything.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+from ..crypto.correct_decryption import CorrectHybridDecrKeyZkp
+from ..crypto.dleq import DleqZkp
+from ..crypto.elgamal import HybridCiphertext, Keypair, SymmetricKey
+from ..dkg import broadcast as bc
+from ..dkg import committee as cm
+from ..dkg.errors import DkgErrorKind
+from ..dkg.procedure_keys import MemberCommunicationKey, MemberCommunicationPublicKey
+from ..groups.host import HostGroup
+
+_ERR_CODES = {k: i for i, k in enumerate(DkgErrorKind)}
+_ERR_FROM = {i: k for k, i in _ERR_CODES.items()}
+
+MAGIC = b"DKGT"
+VERSION = 1
+
+
+class Writer:
+    def __init__(self, group: HostGroup):
+        self.g = group
+        self.buf = bytearray()
+
+    def u8(self, v: int):
+        self.buf.append(v & 0xFF)
+
+    def u16(self, v: int):
+        self.buf += struct.pack("<H", v)
+
+    def u32(self, v: int):
+        self.buf += struct.pack("<I", v)
+
+    def raw(self, b: bytes):
+        self.buf += b
+
+    def lp(self, b: bytes):
+        self.u32(len(b))
+        self.raw(b)
+
+    def point(self, p):
+        self.raw(self.g.encode(p))
+
+    def scalar(self, s: int):
+        self.raw(self.g.scalar_to_bytes(s))
+
+    def bytes(self) -> bytes:
+        return bytes(self.buf)
+
+
+class Reader:
+    class Bad(ValueError):
+        pass
+
+    def __init__(self, group: HostGroup, data: bytes):
+        self.g = group
+        self.data = data
+        self.pos = 0
+        self._point_len = len(group.encode(group.identity()))
+        self._scalar_len = group.scalar_field.nbytes
+
+    def take(self, n: int) -> bytes:
+        if self.pos + n > len(self.data):
+            raise Reader.Bad("truncated")
+        out = self.data[self.pos : self.pos + n]
+        self.pos += n
+        return out
+
+    def u8(self) -> int:
+        return self.take(1)[0]
+
+    def u16(self) -> int:
+        return struct.unpack("<H", self.take(2))[0]
+
+    def u32(self) -> int:
+        return struct.unpack("<I", self.take(4))[0]
+
+    def lp(self) -> bytes:
+        return self.take(self.u32())
+
+    def point(self):
+        p = self.g.decode(self.take(self._point_len))
+        if p is None:
+            raise Reader.Bad("invalid point encoding")
+        return p
+
+    def scalar(self) -> int:
+        s = self.g.scalar_from_bytes(self.take(self._scalar_len))
+        if s is None:
+            raise Reader.Bad("non-canonical scalar")
+        return s
+
+    def done(self):
+        if self.pos != len(self.data):
+            raise Reader.Bad("trailing bytes")
+
+
+# ---------------------------------------------------------------------------
+# wire-message codecs
+# ---------------------------------------------------------------------------
+
+
+def _w_hybrid(w: Writer, c: HybridCiphertext):
+    w.point(c.e1)
+    w.lp(c.ciphertext)
+
+
+def _r_hybrid(r: Reader) -> HybridCiphertext:
+    return HybridCiphertext(r.point(), r.lp())
+
+
+def _w_shares(w: Writer, es: bc.EncryptedShares):
+    w.u16(es.recipient_index)
+    _w_hybrid(w, es.share_ct)
+    _w_hybrid(w, es.randomness_ct)
+
+
+def _r_shares(r: Reader) -> bc.EncryptedShares:
+    return bc.EncryptedShares(r.u16(), _r_hybrid(r), _r_hybrid(r))
+
+
+def _w_dleq(w: Writer, p: DleqZkp):
+    w.scalar(p.challenge)
+    w.scalar(p.response)
+
+
+def _r_dleq(r: Reader) -> DleqZkp:
+    return DleqZkp(r.scalar(), r.scalar())
+
+
+def _w_proof(w: Writer, p: bc.ProofOfMisbehaviour):
+    w.point(p.symm_key_share.point)
+    w.point(p.symm_key_rand.point)
+    _w_dleq(w, p.proof_share.proof)
+    _w_dleq(w, p.proof_rand.proof)
+
+
+def _r_proof(r: Reader) -> bc.ProofOfMisbehaviour:
+    return bc.ProofOfMisbehaviour(
+        SymmetricKey(r.point()),
+        SymmetricKey(r.point()),
+        CorrectHybridDecrKeyZkp(_r_dleq(r)),
+        CorrectHybridDecrKeyZkp(_r_dleq(r)),
+    )
+
+
+def encode_phase1(group: HostGroup, b: bc.BroadcastPhase1) -> bytes:
+    w = Writer(group)
+    w.u16(len(b.committed_coefficients))
+    for p in b.committed_coefficients:
+        w.point(p)
+    w.u16(len(b.encrypted_shares))
+    for es in b.encrypted_shares:
+        _w_shares(w, es)
+    return w.bytes()
+
+
+def decode_phase1(group: HostGroup, data: bytes) -> Optional[bc.BroadcastPhase1]:
+    try:
+        r = Reader(group, data)
+        coeffs = tuple(r.point() for _ in range(r.u16()))
+        shares = tuple(_r_shares(r) for _ in range(r.u16()))
+        r.done()
+        return bc.BroadcastPhase1(coeffs, shares)
+    except Reader.Bad:
+        return None
+
+
+def encode_phase2(group: HostGroup, b: bc.BroadcastPhase2) -> bytes:
+    w = Writer(group)
+    w.u16(len(b.misbehaving_parties))
+    for m in b.misbehaving_parties:
+        w.u16(m.accused_index)
+        w.u8(_ERR_CODES[m.error])
+        _w_proof(w, m.proof)
+    return w.bytes()
+
+
+def decode_phase2(group: HostGroup, data: bytes) -> Optional[bc.BroadcastPhase2]:
+    try:
+        r = Reader(group, data)
+        ms = []
+        for _ in range(r.u16()):
+            idx = r.u16()
+            err = _ERR_FROM.get(r.u8())
+            if err is None:
+                raise Reader.Bad("unknown error code")
+            ms.append(bc.MisbehavingPartiesRound1(idx, err, _r_proof(r)))
+        r.done()
+        return bc.BroadcastPhase2(tuple(ms))
+    except Reader.Bad:
+        return None
+
+
+def encode_phase3(group: HostGroup, b: bc.BroadcastPhase3) -> bytes:
+    w = Writer(group)
+    w.u16(len(b.committed_coefficients))
+    for p in b.committed_coefficients:
+        w.point(p)
+    return w.bytes()
+
+
+def decode_phase3(group: HostGroup, data: bytes) -> Optional[bc.BroadcastPhase3]:
+    try:
+        r = Reader(group, data)
+        coeffs = tuple(r.point() for _ in range(r.u16()))
+        r.done()
+        return bc.BroadcastPhase3(coeffs)
+    except Reader.Bad:
+        return None
+
+
+def encode_phase4(group: HostGroup, b: bc.BroadcastPhase4) -> bytes:
+    w = Writer(group)
+    w.u16(len(b.misbehaving_parties))
+    for m in b.misbehaving_parties:
+        w.u16(m.accused_index)
+        w.scalar(m.share)
+        w.scalar(m.randomness)
+    return w.bytes()
+
+
+def decode_phase4(group: HostGroup, data: bytes) -> Optional[bc.BroadcastPhase4]:
+    try:
+        r = Reader(group, data)
+        ms = tuple(
+            bc.MisbehavingPartiesRound3(r.u16(), r.scalar(), r.scalar())
+            for _ in range(r.u16())
+        )
+        r.done()
+        return bc.BroadcastPhase4(ms)
+    except Reader.Bad:
+        return None
+
+
+def encode_phase5(group: HostGroup, b: bc.BroadcastPhase5) -> bytes:
+    w = Writer(group)
+    w.u16(len(b.disclosed_shares))
+    for d in b.disclosed_shares:
+        w.u16(d.accused_index)
+        w.u16(d.holder_index)
+        w.scalar(d.share)
+    return w.bytes()
+
+
+def decode_phase5(group: HostGroup, data: bytes) -> Optional[bc.BroadcastPhase5]:
+    try:
+        r = Reader(group, data)
+        ds = tuple(
+            bc.DisclosedShare(r.u16(), r.u16(), r.scalar()) for _ in range(r.u16())
+        )
+        r.done()
+        return bc.BroadcastPhase5(ds)
+    except Reader.Bad:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# phase snapshots (checkpoint / resume)
+# ---------------------------------------------------------------------------
+
+_PHASES = {
+    "phase1": cm.DkgPhase1,
+    "phase2": cm.DkgPhase2,
+    "phase3": cm.DkgPhase3,
+    "phase4": cm.DkgPhase4,
+    "phase5": cm.DkgPhase5,
+}
+_PHASE_NAMES = {v: k for k, v in _PHASES.items()}
+
+
+def checkpoint(group: HostGroup, phase) -> bytes:
+    """Serialize a phase object (+ its full state) to bytes."""
+    st: cm._State = phase._state
+    w = Writer(group)
+    w.raw(MAGIC)
+    w.u8(VERSION)
+    name = _PHASE_NAMES[type(phase)].encode()
+    w.lp(name)
+    w.u16(st.env.threshold)
+    w.u16(st.env.nr_members)
+    w.point(st.env.commitment_key.h)
+    w.u16(st.index)
+    w.scalar(st.comm_key.sk)
+    for pk in st.members_pks:
+        w.point(pk.point)
+    w.u16(len(st.bare_coeff_points))
+    for p in st.bare_coeff_points:
+        w.point(p)
+    for p in st.randomized_coeff_points:
+        w.point(p)
+
+    def w_coeff_map(m: dict):
+        w.u16(len(m))
+        for j in sorted(m):
+            w.u16(j)
+            w.u16(len(m[j]))
+            for p in m[j]:
+                w.point(p)
+
+    w.u16(len(st.received_shares))
+    for j in sorted(st.received_shares):
+        w.u16(j)
+        s, r = st.received_shares[j]
+        w.scalar(s)
+        w.scalar(r)
+    w_coeff_map(st.randomized_coeffs)
+    w_coeff_map(st.bare_coeffs)
+    for q in st.qualified:
+        w.u8(q)
+    for group_set in (st.reconstructable, st.phase3_accused):
+        w.u16(len(group_set))
+        for j in sorted(group_set):
+            w.u16(j)
+    has_final = st.final_share is not None
+    w.u8(1 if has_final else 0)
+    if has_final:
+        w.scalar(st.final_share)
+    return w.bytes()
+
+
+def restore(group: HostGroup, data: bytes):
+    """Rebuild the phase object from a checkpoint; raises ValueError on
+    malformed input."""
+    from ..crypto.commitment import CommitmentKey
+
+    r = Reader(group, data)
+    if r.take(4) != MAGIC:
+        raise ValueError("bad magic")
+    if r.u8() != VERSION:
+        raise ValueError("unsupported version")
+    name = r.lp().decode()
+    if name not in _PHASES:
+        raise ValueError("unknown phase")
+    t = r.u16()
+    n = r.u16()
+    ck = CommitmentKey(r.point())
+    env = cm.Environment(group, t, n, ck)
+    index = r.u16()
+    sk = r.scalar()
+    comm_key = MemberCommunicationKey(Keypair.from_secret(group, sk))
+    pks = [MemberCommunicationPublicKey(r.point()) for _ in range(n)]
+    st = cm._State(env, index, comm_key, pks)
+    ncoeff = r.u16()
+    st.bare_coeff_points = tuple(r.point() for _ in range(ncoeff))
+    st.randomized_coeff_points = tuple(r.point() for _ in range(ncoeff))
+
+    def r_coeff_map() -> dict:
+        out = {}
+        for _ in range(r.u16()):
+            j = r.u16()
+            out[j] = tuple(r.point() for _ in range(r.u16()))
+        return out
+
+    st.received_shares = {}
+    for _ in range(r.u16()):
+        j = r.u16()
+        st.received_shares[j] = (r.scalar(), r.scalar())
+    st.randomized_coeffs = r_coeff_map()
+    st.bare_coeffs = r_coeff_map()
+    st.qualified = [r.u8() for _ in range(n)]
+    st.reconstructable = {r.u16() for _ in range(r.u16())}
+    st.phase3_accused = {r.u16() for _ in range(r.u16())}
+    if r.u8():
+        st.final_share = r.scalar()
+        st.public_share = group.scalar_mul(st.final_share, group.generator())
+    r.done()
+    return _PHASES[name](st)

@@ -1,0 +1,157 @@
+"""Serialization tests: wire-message round-trips, malformed-input
+rejection, and checkpoint/resume of a mid-ceremony party."""
+
+import random
+
+from dkg_tpu.dkg import (
+    DistributedKeyGeneration,
+    DkgError,
+    FetchedPhase1,
+    FetchedPhase3,
+    MemberCommunicationKey,
+    sort_committee,
+)
+from dkg_tpu.dkg.committee import Environment
+from dkg_tpu.groups import host as gh
+from dkg_tpu.utils import serde
+
+RNG = random.Random(0x5EDE)
+G = gh.RISTRETTO255
+
+
+def build_ceremony(n=3, t=1):
+    env = Environment.init(G, t, n, b"serde-test")
+    keys = [MemberCommunicationKey.generate(G, RNG) for _ in range(n)]
+    pks = sort_committee(G, [k.public() for k in keys])
+    by_pos = [None] * n
+    for k in keys:
+        enc = G.encode(k.public().point)
+        pos = next(
+            i for i, pk in enumerate(pks) if G.encode(pk.point) == enc
+        )
+        by_pos[pos] = k
+    phases, b1 = [], []
+    for i in range(n):
+        ph, b = DistributedKeyGeneration.init(env, RNG, by_pos[i], pks, i + 1)
+        phases.append(ph)
+        b1.append(b)
+    return env, phases, b1
+
+
+def test_phase1_roundtrip_and_rejection():
+    env, phases, b1 = build_ceremony()
+    data = serde.encode_phase1(G, b1[0])
+    back = serde.decode_phase1(G, data)
+    assert back is not None
+    assert len(back.committed_coefficients) == len(b1[0].committed_coefficients)
+    for a, b in zip(back.committed_coefficients, b1[0].committed_coefficients):
+        assert G.eq(a, b)
+    assert back.encrypted_shares[1].share_ct.ciphertext == b1[0].encrypted_shares[1].share_ct.ciphertext
+    # malformed inputs are rejected, not crashed on
+    assert serde.decode_phase1(G, data[:-1]) is None
+    assert serde.decode_phase1(G, data + b"\x00") is None
+    assert serde.decode_phase1(G, b"") is None
+    corrupted = bytearray(data)
+    # set the top bit of the first point's field element -> s >= p, must
+    # be rejected as non-canonical (count u16 occupies bytes 0-1, the
+    # point is bytes 2..34, little-endian)
+    corrupted[2 + 31] |= 0x80
+    assert serde.decode_phase1(G, bytes(corrupted)) is None
+
+
+def test_phase3_phase5_roundtrip():
+    from dkg_tpu.dkg import BroadcastPhase3, BroadcastPhase5, DisclosedShare
+
+    p = G.scalar_mul(G.random_scalar(RNG), G.generator())
+    b3 = BroadcastPhase3((p, G.generator()))
+    back = serde.decode_phase3(G, serde.encode_phase3(G, b3))
+    assert back and G.eq(back.committed_coefficients[0], p)
+
+    b5 = BroadcastPhase5((DisclosedShare(2, 1, 12345),))
+    back5 = serde.decode_phase5(G, serde.encode_phase5(G, b5))
+    assert back5 and back5.disclosed_shares[0] == DisclosedShare(2, 1, 12345)
+
+
+def test_phase2_complaint_roundtrip():
+    # build a real complaint by corrupting a dealer, then round-trip it
+    from dkg_tpu.crypto import hybrid_encrypt
+    from dkg_tpu.dkg import BroadcastPhase1
+
+    env, phases, b1 = build_ceremony()
+    bad = b1[2]
+    tampered = list(bad.encrypted_shares)
+    es = tampered[0]
+    tampered[0] = type(es)(
+        1,
+        hybrid_encrypt(G, phases[0]._state.members_pks[0].point,
+                       G.scalar_to_bytes(G.random_scalar(RNG)), RNG),
+        es.randomness_ct,
+    )
+    b1[2] = BroadcastPhase1(bad.committed_coefficients, tuple(tampered))
+    fetched = [
+        FetchedPhase1.from_broadcast(env, j + 1, b1[j]) for j in (1, 2)
+    ]
+    nxt, b2 = phases[0].proceed(fetched, RNG)
+    assert b2 is not None
+    data = serde.encode_phase2(G, b2)
+    back = serde.decode_phase2(G, data)
+    assert back is not None
+    m = back.misbehaving_parties[0]
+    assert m.accused_index == 3
+    # the deserialized complaint still verifies
+    assert m.verify(G, env.commitment_key, 1, phases[0]._state.members_pks[0], b1[2])
+    assert serde.decode_phase2(G, data[:-2]) is None
+
+
+def test_checkpoint_resume_completes_ceremony():
+    n, t = 3, 1
+    env, phases, b1 = build_ceremony(n, t)
+    fetched1 = lambda me: [
+        FetchedPhase1.from_broadcast(env, j + 1, b1[j]) for j in range(n) if j != me
+    ]
+    phases2 = []
+    for i in range(n):
+        nxt, _ = phases[i].proceed(fetched1(i), RNG)
+        assert not isinstance(nxt, DkgError)
+        phases2.append(nxt)
+
+    # checkpoint every party after phase 1->2, then resume from bytes
+    blobs = [serde.checkpoint(G, p) for p in phases2]
+    resumed = [serde.restore(G, b) for b in blobs]
+
+    all_r1 = [FetchedPhase1.from_broadcast(env, j + 1, b1[j]) for j in range(n)]
+    phases3, b3 = [], []
+    for i in range(n):
+        nxt, b = resumed[i].proceed([], all_r1)
+        assert not isinstance(nxt, DkgError)
+        phases3.append(nxt)
+        b3.append(b)
+
+    fetched3 = lambda me: [
+        FetchedPhase3.from_broadcast(env, j + 1, b3[j]) for j in range(n) if j != me
+    ]
+    results = []
+    for i in range(n):
+        p4, _ = phases3[i].proceed(fetched3(i))
+        assert not isinstance(p4, DkgError)
+        p5, _ = p4.proceed([])
+        assert not isinstance(p5, DkgError)
+        res, _ = p5.finalise([])
+        assert not isinstance(res, DkgError)
+        results.append(res)
+
+    for mk, _ in results[1:]:
+        assert G.eq(mk.point, results[0][0].point)
+
+
+def test_checkpoint_rejects_garbage():
+    env, phases, _ = build_ceremony()
+    blob = serde.checkpoint(G, phases[0])
+    restored = serde.restore(G, blob)
+    assert restored._state.index == phases[0]._state.index
+    for bad in (b"", b"XXXX" + blob[4:], blob[:-3]):
+        try:
+            serde.restore(G, bad)
+            assert False, "expected ValueError"
+        except ValueError:
+            pass
