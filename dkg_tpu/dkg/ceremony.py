@@ -290,16 +290,32 @@ class BatchedCeremony:
             fh.encode(fs, [[fs.rand_int(rng) for _ in range(t + 1)] for _ in range(n)])
         )
 
-    def run(self, rho_bits: int = 128):
-        """Happy-path ceremony; returns dict of device results."""
+    def run(self, rho_bits: int = 128, trace=None):
+        """Happy-path ceremony; returns dict of device results.  Pass a
+        :class:`dkg_tpu.utils.tracing.CeremonyTrace` to collect per-phase
+        wall-clock + device profiler annotations."""
+        import jax as _jax
+
+        from ..utils.tracing import phase_span
+
         cfg = self.cfg
-        a, e, s, r = deal(cfg, self.coeffs_a, self.coeffs_b, self.g_table, self.h_table)
+        with phase_span(trace, "deal"):
+            a, e, s, r = deal(
+                cfg, self.coeffs_a, self.coeffs_b, self.g_table, self.h_table
+            )
+            _jax.block_until_ready(e)
         transcript = np.asarray(e).tobytes()[:4096]
         rho = jnp.asarray(fiat_shamir_rho(cfg, transcript, rho_bits))
-        ok = verify_batch(cfg, e, s, r, rho, rho_bits, self.g_table, self.h_table)
-        qualified = jnp.ones((cfg.n,), bool)
-        final_shares = aggregate_shares(cfg, s, qualified)
-        master = master_key_from_bare(cfg, a, qualified)
+        with phase_span(trace, "verify"):
+            ok = verify_batch(cfg, e, s, r, rho, rho_bits, self.g_table, self.h_table)
+            _jax.block_until_ready(ok)
+        with phase_span(trace, "finalise"):
+            qualified = jnp.ones((cfg.n,), bool)
+            final_shares = aggregate_shares(cfg, s, qualified)
+            master = master_key_from_bare(cfg, a, qualified)
+            _jax.block_until_ready(master)
+        if trace is not None:
+            trace.meta.update({"curve": cfg.curve, "n": cfg.n, "t": cfg.t})
         return {
             "bare": a,
             "randomized": e,

@@ -1,0 +1,81 @@
+"""Ceremony observability: per-phase wall-clock, counters, profiler hooks.
+
+The reference has no tracing/metrics/logging of any kind (SURVEY §5 —
+errors are the only signal).  Here observability is first-class:
+
+* :class:`CeremonyTrace` — structured per-phase timings + protocol
+  counters (complaints filed/upheld, disqualifications, reconstructions),
+  rendered as one JSON-able dict.
+* :func:`phase_span` — context manager timing one phase; nests under a
+  trace and (optionally) a ``jax.profiler.TraceAnnotation`` so device
+  kernels show up named in TPU profiles.
+* :func:`profile_to` — whole-ceremony ``jax.profiler`` capture helper.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class CeremonyTrace:
+    """Mutable trace of one ceremony run."""
+
+    timings_s: dict = field(default_factory=dict)  # phase -> seconds
+    counters: dict = field(default_factory=dict)  # name -> int
+    meta: dict = field(default_factory=dict)
+
+    def bump(self, name: str, by: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + by
+
+    def record(self, phase: str, seconds: float) -> None:
+        self.timings_s[phase] = self.timings_s.get(phase, 0.0) + seconds
+
+    @property
+    def total_s(self) -> float:
+        return sum(self.timings_s.values())
+
+    def as_dict(self) -> dict:
+        return {
+            "timings_s": dict(self.timings_s),
+            "total_s": self.total_s,
+            "counters": dict(self.counters),
+            "meta": dict(self.meta),
+        }
+
+    def json(self) -> str:
+        return json.dumps(self.as_dict(), sort_keys=True)
+
+
+@contextlib.contextmanager
+def phase_span(trace: CeremonyTrace | None, phase: str, annotate_device: bool = True):
+    """Time a phase; also annotates the device profile when jax has a
+    profiler available (no-op overhead otherwise)."""
+    ann = contextlib.nullcontext()
+    if annotate_device:
+        try:
+            import jax.profiler
+
+            ann = jax.profiler.TraceAnnotation(f"dkg/{phase}")
+        except Exception:  # pragma: no cover - profiler unavailable
+            pass
+    t0 = time.perf_counter()
+    with ann:
+        yield
+    if trace is not None:
+        trace.record(phase, time.perf_counter() - t0)
+
+
+@contextlib.contextmanager
+def profile_to(logdir: str):
+    """Capture a jax profiler trace for the enclosed ceremony section."""
+    import jax.profiler
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,33 @@
+"""Tracing subsystem tests."""
+
+import json
+import random
+
+from dkg_tpu.dkg import ceremony as ce
+from dkg_tpu.utils.tracing import CeremonyTrace, phase_span
+
+
+def test_trace_records_phases_and_counters():
+    tr = CeremonyTrace()
+    with phase_span(tr, "deal"):
+        pass
+    with phase_span(tr, "verify"):
+        pass
+    tr.bump("complaints_filed")
+    tr.bump("complaints_filed")
+    tr.bump("disqualified")
+    d = tr.as_dict()
+    assert set(d["timings_s"]) == {"deal", "verify"}
+    assert d["counters"] == {"complaints_filed": 2, "disqualified": 1}
+    assert d["total_s"] >= 0
+    json.loads(tr.json())  # serializable
+
+
+def test_ceremony_run_with_trace():
+    rng = random.Random(1)
+    c = ce.BatchedCeremony("ristretto255", 5, 2, b"traced", rng)
+    tr = CeremonyTrace()
+    out = c.run(rho_bits=64, trace=tr)
+    assert bool(out["ok"].all())
+    assert set(tr.timings_s) == {"deal", "verify", "finalise"}
+    assert tr.meta["n"] == 5 and tr.meta["curve"] == "ristretto255"
