@@ -1,0 +1,79 @@
+"""Native (C++) host runtime parity tests vs the Python-int oracle.
+
+Skipped wholesale when no toolchain is available (native runtime is an
+optional accelerator, never a correctness dependency).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from dkg_tpu import native
+from dkg_tpu.fields import ALL_FIELDS
+from dkg_tpu.groups import host as gh
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable"
+)
+
+RNG = random.Random(0x4A71)
+
+FIELDS = list(ALL_FIELDS.values())
+
+
+@pytest.mark.parametrize("fs", FIELDS, ids=[f.name for f in FIELDS])
+def test_native_field_parity(fs):
+    nf = native.NativeField(fs.modulus)
+    a = [RNG.randrange(fs.modulus) for _ in range(32)] + [0, 1, fs.modulus - 1]
+    b = list(reversed(a))
+    da, db = nf.encode(a), nf.encode(b)
+    got_add = nf.decode(nf.add(da, db))
+    got_sub = nf.decode(nf.sub(da, db))
+    got_mul = nf.decode(nf.mul(da, db))
+    for i, (x, y) in enumerate(zip(a, b)):
+        assert got_add[i] == (x + y) % fs.modulus
+        assert got_sub[i] == (x - y) % fs.modulus
+        assert got_mul[i] == (x * y) % fs.modulus
+    x = a[5] or 7
+    assert nf.decode(nf.pow(nf.encode([x])[0], 65537))[0] == pow(x, 65537, fs.modulus)
+    assert nf.decode(nf.inv(nf.encode([x])[0]))[0] == pow(x, fs.modulus - 2, fs.modulus)
+
+
+@pytest.mark.parametrize(
+    "g,kind,const",
+    [
+        (gh.RISTRETTO255, "edwards", 2 * gh.D % gh.P),
+        (gh.SECP256K1, "weierstrass_a0", 21),
+        (gh.BLS12_381_G1, "weierstrass_a0", 12),
+    ],
+    ids=["ristretto255", "secp256k1", "bls12_381_g1"],
+)
+def test_native_curve_parity(g, kind, const):
+    nc = native.NativeCurve(kind, g.base_field.modulus, const)
+    pts = [g.scalar_mul(g.random_scalar(RNG), g.generator()) for _ in range(6)]
+    qts = [g.scalar_mul(g.random_scalar(RNG), g.generator()) for _ in range(6)]
+    got = nc.decode_points(nc.add(nc.encode_points(pts), nc.encode_points(qts)))
+    for a, b, c in zip(pts, qts, got):
+        assert g.eq(c, g.add(a, b))
+    # doubling via the unified path (p + p)
+    got2 = nc.decode_points(nc.add(nc.encode_points(pts), nc.encode_points(pts)))
+    for a, c in zip(pts, got2):
+        assert g.eq(c, g.add(a, a))
+    # scalar mult
+    ks = [g.random_scalar(RNG) for _ in range(4)] + [0, 1]
+    base = [g.generator()] * len(ks)
+    got3 = nc.decode_points(
+        nc.scalar_mul(ks, nc.encode_points(base), g.scalar_field.modulus)
+    )
+    for k, c in zip(ks, got3):
+        assert g.eq(c, g.scalar_mul(k, g.generator()))
+
+
+def test_native_chacha_matches_python():
+    from dkg_tpu.crypto.chacha import chacha20_xor as py_chacha
+
+    key = bytes(range(32))
+    nonce = bytes(12)
+    data = bytes(range(256)) * 3
+    assert native.chacha20_xor(key, nonce, data, 1) == py_chacha(key, nonce, data, 1)
