@@ -3,6 +3,7 @@
 from .commitment import CommitmentKey, Open, commit, commit_with_random, verify  # noqa: F401
 from .correct_decryption import CorrectHybridDecrKeyZkp  # noqa: F401
 from .dleq import DleqZkp  # noqa: F401
+from . import dleq_batch  # noqa: F401
 from .elgamal import (  # noqa: F401
     Ciphertext,
     HybridCiphertext,

@@ -1,0 +1,90 @@
+"""Batched DLEQ proof generation/verification for complaint storms.
+
+The reference verifies each complaint's two DLEQ proofs one at a time
+(reference: src/dkg/broadcast.rs:50-98 re-running zkp.rs:54-74 per
+accusation — 4 serial scalar mults each).  In a large ceremony a storm
+of k complaints means 4k scalar multiplications; here all of them run
+as ONE batched device ladder call, and only the Blake2b Fiat-Shamir
+transcript hashing (byte-level, off the hot path) stays host-side —
+the same device/host split as hybrid encryption (SURVEY §7 step 4).
+
+Proof convention matches crypto/dleq.py exactly: challenge
+e = H(b1, b2, h1, h2, a1, a2), response z = w + e*x, verify by
+recomputing a_i = b_i*z - h_i*e.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..fields import host as fh
+from ..groups import device as gd
+from ..groups import host as gh
+from .dleq import DleqZkp, _challenge
+
+
+def _pairs_to_device(cs, points_a, points_b):
+    """Two length-k host point lists -> one (k, 2, C, L) device tensor."""
+    k = len(points_a)
+    interleaved = [p for pair in zip(points_a, points_b) for p in pair]
+    dev = gd.from_host(cs, interleaved)
+    return dev.reshape(k, 2, cs.ncoords, cs.field.limbs)
+
+
+def generate_batch(
+    group: gh.HostGroup,
+    cs,
+    statements: list[tuple],  # (base1, base2, point1, point2, dlog)
+    rng,
+) -> list[DleqZkp]:
+    """Batched prover: all 2k announcement scalar-mults in one device
+    call; challenges + responses finish host-side per proof."""
+    if not statements:
+        return []
+    q = group.scalar_field.modulus
+    ws = [group.random_scalar(rng) for _ in statements]
+    bases = _pairs_to_device(cs, [s[0] for s in statements], [s[1] for s in statements])
+    w_limbs = jnp.asarray(fh.encode(group.scalar_field, [[w, w] for w in ws]))
+    ann = gd.to_host(cs, np.asarray(gd.scalar_mul(cs, w_limbs, bases)).reshape(-1, cs.ncoords, cs.field.limbs))
+    out = []
+    for i, (b1, b2, h1, h2, x) in enumerate(statements):
+        a1, a2 = ann[2 * i], ann[2 * i + 1]
+        e = _challenge(group, b1, b2, h1, h2, a1, a2)
+        out.append(DleqZkp(e, (ws[i] + e * x) % q))
+    return out
+
+
+def verify_batch(
+    group: gh.HostGroup,
+    cs,
+    proofs: list[DleqZkp],
+    statements: list[tuple],  # (base1, base2, point1, point2)
+) -> np.ndarray:
+    """Batched verifier -> boolean array, one entry per proof.
+
+    Device work: a_i = b_i*z - h_i*e for every proof at once — the 4k
+    ladders collapse into one (2k, 2)-lane batched call (z·b stacked
+    with e·h), then one batched point subtraction.
+    """
+    if not proofs:
+        return np.zeros((0,), dtype=bool)
+    k = len(proofs)
+    fs = group.scalar_field
+    bases = _pairs_to_device(cs, [s[0] for s in statements], [s[1] for s in statements])
+    points = _pairs_to_device(cs, [s[2] for s in statements], [s[3] for s in statements])
+    z_limbs = jnp.asarray(fh.encode(fs, [[p.response] * 2 for p in proofs]))
+    e_limbs = jnp.asarray(fh.encode(fs, [[p.challenge] * 2 for p in proofs]))
+    # one ladder over the stacked (2k, 2) batch: rows 0..k-1 are z·b,
+    # rows k..2k-1 are e·h
+    scalars = jnp.concatenate([z_limbs, e_limbs], axis=0)
+    pts = jnp.concatenate([bases, points], axis=0)
+    prod = gd.scalar_mul(cs, scalars, pts)
+    ann = gd.add(cs, prod[:k], gd.neg(cs, prod[k:]))
+    ann_host = gd.to_host(cs, np.asarray(ann).reshape(-1, cs.ncoords, cs.field.limbs))
+    ok = np.zeros((k,), dtype=bool)
+    for i, (proof, (b1, b2, h1, h2)) in enumerate(zip(proofs, statements)):
+        a1, a2 = ann_host[2 * i], ann_host[2 * i + 1]
+        ok[i] = proof.challenge == _challenge(group, b1, b2, h1, h2, a1, a2)
+    return ok

@@ -100,13 +100,29 @@ class SymmetricKey:
     point: tuple
 
 
-def _keystream_params(group: HostGroup, kem_point: tuple) -> tuple[bytes, bytes]:
-    """Blake2b-512(encode(kem_point)) -> (32-byte key, 12-byte nonce)
-    (reference: elgamal.rs:180-193 initialise_encryption)."""
-    digest = hashlib.blake2b(
-        group.encode(kem_point), digest_size=64, person=b"dkgtpu-kdf"
-    ).digest()
+# KDF personalisation tags.  A (share, commitment-randomness) pair is
+# sealed under ONE KEM point with distinct tags — one ElGamal
+# exponentiation per recipient instead of the reference's two
+# (elgamal.rs:134-145 is invoked twice per recipient from
+# procedure_keys.rs:113-119); domain separation comes from the tag.
+PERSON_SHARE = b"dkgtpu-kdf"
+PERSON_RAND = b"dkgtpu-kd2"
+
+
+def keystream_from_kem_bytes(kem_bytes: bytes, person: bytes) -> tuple[bytes, bytes]:
+    """Blake2b-512(kem_bytes) -> (32-byte key, 12-byte nonce).  The ONE
+    definition of the KDF layout — the batched device path
+    (dkg.hybrid_batch) feeds precomputed point encodings through here
+    too, so wire and batched paths cannot desynchronise."""
+    digest = hashlib.blake2b(kem_bytes, digest_size=64, person=person).digest()
     return digest[:32], digest[32:44]
+
+
+def _keystream_params(
+    group: HostGroup, kem_point: tuple, person: bytes = PERSON_SHARE
+) -> tuple[bytes, bytes]:
+    """(reference: elgamal.rs:180-193 initialise_encryption)"""
+    return keystream_from_kem_bytes(group.encode(kem_point), person)
 
 
 def hybrid_encrypt(group: HostGroup, pk: tuple, message: bytes, rng) -> HybridCiphertext:
@@ -116,11 +132,11 @@ def hybrid_encrypt(group: HostGroup, pk: tuple, message: bytes, rng) -> HybridCi
 
 
 def hybrid_encrypt_with_random(
-    group: HostGroup, pk: tuple, message: bytes, r: int
+    group: HostGroup, pk: tuple, message: bytes, r: int, person: bytes = PERSON_SHARE
 ) -> HybridCiphertext:
     e1 = group.scalar_mul(r, group.generator())
     kem = group.scalar_mul(r, pk)
-    key, nonce = _keystream_params(group, kem)
+    key, nonce = _keystream_params(group, kem, person)
     return HybridCiphertext(e1, chacha20_xor(key, nonce, message))
 
 
@@ -130,13 +146,60 @@ def recover_symmetric_key(group: HostGroup, sk: int, c: HybridCiphertext) -> Sym
 
 
 def hybrid_decrypt_with_key(
-    group: HostGroup, symm: SymmetricKey, c: HybridCiphertext
+    group: HostGroup, symm: SymmetricKey, c: HybridCiphertext, person: bytes = PERSON_SHARE
 ) -> bytes:
     """Decrypt given a disclosed KEM key — the complaint-verification path
     (reference: elgamal.rs:147-155 + broadcast.rs:244-255)."""
-    key, nonce = _keystream_params(group, symm.point)
+    key, nonce = _keystream_params(group, symm.point, person)
     return chacha20_xor(key, nonce, c.ciphertext)
 
 
-def hybrid_decrypt(group: HostGroup, sk: int, c: HybridCiphertext) -> bytes:
-    return hybrid_decrypt_with_key(group, recover_symmetric_key(group, sk, c), c)
+def hybrid_decrypt(
+    group: HostGroup, sk: int, c: HybridCiphertext, person: bytes = PERSON_SHARE
+) -> bytes:
+    return hybrid_decrypt_with_key(group, recover_symmetric_key(group, sk, c), c, person)
+
+
+# ---------------------------------------------------------------------------
+# pair sealing — the canonical wire format for share delivery
+# ---------------------------------------------------------------------------
+
+
+def rand_person(group: HostGroup, share_ct: HybridCiphertext, rand_ct: HybridCiphertext) -> bytes:
+    """KDF tag for the randomness ciphertext of a pair: PERSON_RAND when
+    it shares the KEM point with the share ciphertext (the canonical
+    sealed-pair format), PERSON_SHARE for independently-encrypted pairs
+    (the reference's two-KEM layout, still accepted)."""
+    return PERSON_RAND if group.eq(share_ct.e1, rand_ct.e1) else PERSON_SHARE
+
+
+def seal_pair(
+    group: HostGroup, pk: tuple, share_bytes: bytes, rand_bytes: bytes, rng
+) -> tuple[HybridCiphertext, HybridCiphertext]:
+    """Seal a (share, randomness) pair under one KEM exponentiation."""
+    r = group.random_scalar(rng)
+    e1 = group.scalar_mul(r, group.generator())
+    kem = group.scalar_mul(r, pk)
+    k1, n1 = _keystream_params(group, kem, PERSON_SHARE)
+    k2, n2 = _keystream_params(group, kem, PERSON_RAND)
+    return (
+        HybridCiphertext(e1, chacha20_xor(k1, n1, share_bytes)),
+        HybridCiphertext(e1, chacha20_xor(k2, n2, rand_bytes)),
+    )
+
+
+def open_pair(
+    group: HostGroup, sk: int, share_ct: HybridCiphertext, rand_ct: HybridCiphertext
+) -> tuple[bytes, bytes]:
+    """Decrypt a pair, honouring either pair layout (see rand_person).
+
+    The canonical shared-KEM layout costs ONE sk*e1 exponentiation for
+    both payloads; the legacy two-KEM layout falls back to two.
+    """
+    kem1 = recover_symmetric_key(group, sk, share_ct)
+    pt1 = hybrid_decrypt_with_key(group, kem1, share_ct, PERSON_SHARE)
+    if group.eq(share_ct.e1, rand_ct.e1):
+        pt2 = hybrid_decrypt_with_key(group, kem1, rand_ct, PERSON_RAND)
+    else:
+        pt2 = hybrid_decrypt(group, sk, rand_ct, PERSON_SHARE)
+    return pt1, pt2

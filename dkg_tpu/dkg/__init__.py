@@ -26,6 +26,7 @@ from .committee import (  # noqa: F401
     FetchedPhase3,
     FetchedPhase5,
 )
+from . import complaints_batch, committee_batch, hybrid_batch  # noqa: F401
 from .errors import DkgError, DkgErrorKind, ProofError  # noqa: F401
 from .procedure_keys import (  # noqa: F401
     MasterPublicKey,

@@ -20,9 +20,11 @@ from typing import Optional
 from ..crypto.commitment import CommitmentKey
 from ..crypto.correct_decryption import CorrectHybridDecrKeyZkp
 from ..crypto.elgamal import (
+    PERSON_SHARE,
     HybridCiphertext,
     SymmetricKey,
     hybrid_decrypt_with_key,
+    rand_person,
     recover_symmetric_key,
 )
 from ..groups.host import HostGroup
@@ -131,12 +133,13 @@ class ProofOfMisbehaviour:
         self, group: HostGroup, shares: EncryptedShares
     ) -> tuple[Optional[int], Optional[int]]:
         fs = group.scalar_field
+        rp = rand_person(group, shares.share_ct, shares.randomness_ct)
         out = []
-        for key, ct in (
-            (self.symm_key_share, shares.share_ct),
-            (self.symm_key_rand, shares.randomness_ct),
+        for key, ct, person in (
+            (self.symm_key_share, shares.share_ct, PERSON_SHARE),
+            (self.symm_key_rand, shares.randomness_ct, rp),
         ):
-            pt = hybrid_decrypt_with_key(group, key, ct)
+            pt = hybrid_decrypt_with_key(group, key, ct, person)
             v = int.from_bytes(pt, "little") if len(pt) == fs.nbytes else None
             out.append(v if v is None or v < fs.modulus else None)
         return out[0], out[1]

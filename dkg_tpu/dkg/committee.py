@@ -229,7 +229,11 @@ class DistributedKeyGeneration:
         state.randomized_coeffs[my] = tuple(randomized)
         state.bare_coeffs[my] = tuple(bare)
 
-        # hot loop #2 (committee.rs:163-186): per-recipient eval + encrypt
+        # hot loop #2 (committee.rs:163-186): per-recipient eval + encrypt.
+        # One KEM exponentiation seals both payloads (elgamal.seal_pair)
+        # — the reference performs two (procedure_keys.rs:113-119).
+        from ..crypto.elgamal import seal_pair
+
         encrypted = []
         for i in range(1, env.nr_members + 1):
             s_i = sharing.evaluate(i)
@@ -237,15 +241,14 @@ class DistributedKeyGeneration:
             if i == my:
                 state.received_shares[my] = (s_i, r_i)
             pk_i = pks[i - 1].point
-            from ..crypto.elgamal import hybrid_encrypt
-
-            encrypted.append(
-                EncryptedShares(
-                    i,
-                    hybrid_encrypt(group, pk_i, group.scalar_to_bytes(s_i), rng),
-                    hybrid_encrypt(group, pk_i, group.scalar_to_bytes(r_i), rng),
-                )
+            share_ct, rand_ct = seal_pair(
+                group,
+                pk_i,
+                group.scalar_to_bytes(s_i),
+                group.scalar_to_bytes(r_i),
+                rng,
             )
+            encrypted.append(EncryptedShares(i, share_ct, rand_ct))
 
         broadcast = BroadcastPhase1(tuple(randomized), tuple(encrypted))
         return DkgPhase1(state), broadcast

@@ -1,0 +1,108 @@
+"""Device-accelerated round-1 dealing for co-located committee members.
+
+``DistributedKeyGeneration.init`` (committee.py) is the per-party wire
+path: serial host scalar-mults per coefficient and per recipient
+(mirroring reference committee.rs:124-216).  When a host drives many
+parties — the sharded-ceremony deployment, or any simulation — dealing
+for all of them at once is a batched device job:
+
+* commitments A_l / E_l for every local dealer: two fixed-base batch
+  mults (ceremony.deal; reference hot loop #1, committee.rs:151-159);
+* the share matrix via batched Horner (reference hot loop #2,
+  committee.rs:163-186 / polynomial.rs:68-74);
+* KEM points for every (dealer, recipient) pair: two batched ladder
+  calls (hybrid_batch.kem_batch; reference elgamal.rs:134-145);
+* DEM sealing + wire packaging host-side (hybrid_batch.seal_shares).
+
+The result is bit-identical in structure to n independent ``init``
+calls: each local party gets a ``DkgPhase1`` whose state machine then
+proceeds through phases 2-5 exactly as the host path — so the fast
+dealing path and the reference-parity protocol logic compose.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..fields import host as fh
+from ..groups import device as gd
+from .committee import DkgPhase1, Environment, _State
+from .hybrid_batch import broadcasts_from_batch, kem_batch, seal_shares
+from .broadcast import BroadcastPhase1
+from .ceremony import CeremonyConfig, deal
+from .procedure_keys import MemberCommunicationKey, sort_committee
+
+
+def batched_dealing(
+    env: Environment,
+    rng,
+    comm_keys: list[MemberCommunicationKey],
+    members: list[int] | None = None,
+) -> list[tuple[DkgPhase1, BroadcastPhase1]]:
+    """Round-1 dealing for the local parties ``members`` (1-based sorted
+    indices; default: every committee member, the in-process-simulation
+    case).  ``comm_keys`` holds the full committee's keys in unsorted
+    order; each local party must have its key present.
+
+    Returns one (phase1, broadcast) pair per local party, in ``members``
+    order — drop-in for per-party ``DistributedKeyGeneration.init``.
+    """
+    group = env.group
+    cs = gd.ALL_CURVES[group.name]
+    fs = group.scalar_field
+    n, t = env.nr_members, env.threshold
+    if len(comm_keys) != n:
+        raise ValueError("committee size does not match environment")
+    pks = sort_committee(group, [k.public() for k in comm_keys])
+    key_by_enc = {group.encode(k.public().point): k for k in comm_keys}
+    sorted_keys = [key_by_enc[group.encode(p.point)] for p in pks]
+    if members is None:
+        members = list(range(1, n + 1))
+    m = len(members)
+
+    cfg = CeremonyConfig(group.name, n, t)
+    g_table = gd.fixed_base_table(cs, group.generator())
+    h_table = gd.fixed_base_table(cs, env.commitment_key.h)
+
+    # secret sampling stays host-side CSPRNG (SURVEY §7 hard part f)
+    coeffs_a = jnp.asarray(
+        fh.encode(fs, [[fs.rand_int(rng) for _ in range(t + 1)] for _ in range(m)])
+    )
+    coeffs_b = jnp.asarray(
+        fh.encode(fs, [[fs.rand_int(rng) for _ in range(t + 1)] for _ in range(m)])
+    )
+    bare_dev, rand_dev, shares_dev, hidings_dev = deal(
+        cfg, coeffs_a, coeffs_b, g_table, h_table
+    )
+
+    # device KEM for all (dealer, recipient) pairs
+    pks_dev = gd.from_host(cs, [p.point for p in pks])
+    r_enc = jnp.asarray(
+        fh.encode(fs, [[fs.rand_int(rng) for _ in range(n)] for _ in range(m)])
+    )
+    c1, kem = kem_batch(cfg, pks_dev, r_enc, g_table)
+    sealed = seal_shares(
+        group, cfg, np.asarray(shares_dev), np.asarray(hidings_dev),
+        np.asarray(c1), np.asarray(kem),
+    )
+    broadcasts = broadcasts_from_batch(group, cfg, np.asarray(rand_dev), sealed)
+
+    shares_host = fh.decode(fs, np.asarray(shares_dev))
+    hidings_host = fh.decode(fs, np.asarray(hidings_dev))
+    bare_host = [gd.to_host(cs, np.asarray(bare_dev[d])) for d in range(m)]
+    rand_host = [gd.to_host(cs, np.asarray(rand_dev[d])) for d in range(m)]
+
+    out = []
+    for d, my in enumerate(members):
+        state = _State(env, my, sorted_keys[my - 1], pks)
+        state.bare_coeff_points = tuple(bare_host[d])
+        state.randomized_coeff_points = tuple(rand_host[d])
+        state.bare_coeffs[my] = state.bare_coeff_points
+        state.randomized_coeffs[my] = state.randomized_coeff_points
+        state.received_shares[my] = (
+            int(shares_host[d, my - 1]),
+            int(hidings_host[d, my - 1]),
+        )
+        out.append((DkgPhase1(state), broadcasts[d]))
+    return out

@@ -1,0 +1,128 @@
+"""Batched adjudication of round-2 complaint storms.
+
+The host state machine verifies complaints one at a time
+(committee.DkgPhase2.proceed -> MisbehavingPartiesRound1.verify;
+reference: committee.rs:369-398 -> broadcast.rs:50-98): per complaint
+that is 2 DLEQ verifications (8 scalar mults) plus a Pedersen/MSM share
+re-check.  Under a storm of k complaints (the adversarial worst case the
+threshold bound t admits), the serial path does O(k) ladder calls; here
+the DLEQ legs of ALL complaints run as one batched device call
+(crypto.dleq_batch.verify_batch) and the share re-checks as one more,
+with only Blake2b transcript hashing and bookkeeping left on host.
+
+Semantics match the serial path exactly — tests assert equality of the
+upheld/rejected verdicts per complaint.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..crypto.commitment import CommitmentKey
+from ..crypto import dleq_batch
+from ..fields import host as fh
+from ..groups import device as gd
+from ..groups import host as gh
+from .broadcast import BroadcastPhase1, MisbehavingPartiesRound1
+from .procedure_keys import MemberCommunicationPublicKey
+
+
+def check_randomized_shares_batch(
+    group: gh.HostGroup,
+    cs,
+    ck: CommitmentKey,
+    indices: list[int],
+    shares: list[int],
+    rands: list[int],
+    coeffs_list: list[tuple],
+) -> np.ndarray:
+    """Batched g*s + h*s' == sum_l idx^l E_l over k independent checks.
+
+    One fixed-base double-mult batch + one batched point-Horner replaces
+    k serial MSMs (the check at reference committee.rs:292-296 / its
+    re-run inside broadcast.rs:50-98).
+    """
+    if not indices:
+        return np.zeros((0,), dtype=bool)
+    fs = group.scalar_field
+    k = len(indices)
+    tp1 = len(coeffs_list[0])
+    # lhs = g*s + h*s'
+    g_tab = gd.fixed_base_table(cs, group.generator())
+    h_tab = gd.fixed_base_table(cs, ck.h)
+    s_limbs = jnp.asarray(fh.encode(fs, shares))
+    r_limbs = jnp.asarray(fh.encode(fs, rands))
+    lhs = gd.add(cs, gd.fixed_base_mul(cs, g_tab, s_limbs), gd.fixed_base_mul(cs, h_tab, r_limbs))
+    # rhs: Horner over the coefficient points at the accuser indices
+    flat_coeffs = [c for coeffs in coeffs_list for c in coeffs]
+    cpts = gd.from_host(cs, flat_coeffs).reshape(k, tp1, cs.ncoords, cs.field.limbs)
+    idx = jnp.asarray(indices, dtype=jnp.uint32)
+    nbits = max(2, int(max(indices)).bit_length())
+    rhs = gd.eval_point_poly(cs, cpts, idx, nbits)
+    return np.asarray(gd.eq(cs, lhs, rhs))
+
+
+def adjudicate_round1_batch(
+    group: gh.HostGroup,
+    cs,
+    ck: CommitmentKey,
+    fetched_complaints: list[tuple[int, MemberCommunicationPublicKey, MisbehavingPartiesRound1]],
+    round1_by_sender: dict[int, BroadcastPhase1 | None],
+) -> list[bool]:
+    """Adjudicate (accuser_index, accuser_pk, complaint) triples at once.
+
+    Returns one upheld/rejected verdict per triple, equal to running
+    ``MisbehavingPartiesRound1.verify`` serially (broadcast.rs:50-98):
+    a complaint is upheld iff both disclosed-KEM-key proofs verify AND
+    the re-decrypted pair is undecodable or fails the commitment check.
+    """
+    k = len(fetched_complaints)
+    verdicts = [False] * k
+    # stage 1: gather DLEQ statements for complaints whose target dealt
+    dleq_stmts, dleq_proofs, owner = [], [], []
+    located = {}
+    for i, (accuser_idx, accuser_pk, m) in enumerate(fetched_complaints):
+        b = round1_by_sender.get(m.accused_index)
+        shares = b.shares_for(accuser_idx) if b is not None else None
+        if shares is None:
+            continue  # accused never dealt to the accuser: reject here
+        located[i] = shares
+        gpt = group.generator()
+        dleq_stmts.append((gpt, shares.share_ct.e1, accuser_pk.point, m.proof.symm_key_share.point))
+        dleq_proofs.append(m.proof.proof_share.proof)
+        owner.append(i)
+        dleq_stmts.append((gpt, shares.randomness_ct.e1, accuser_pk.point, m.proof.symm_key_rand.point))
+        dleq_proofs.append(m.proof.proof_rand.proof)
+        owner.append(i)
+    ok = dleq_batch.verify_batch(group, cs, dleq_proofs, dleq_stmts)
+    proof_ok = {i: True for i in located}
+    for j, i in enumerate(owner):
+        proof_ok[i] = proof_ok[i] and bool(ok[j])
+
+    # stage 2: re-decrypt + batched commitment re-check for survivors
+    recheck = []  # (i, idx, s, r, coeffs)
+    for i, shares in located.items():
+        if not proof_ok[i]:
+            continue
+        accuser_idx, _, m = fetched_complaints[i]
+        s, r = m.proof.decrypt_scalars(group, shares)
+        if s is None or r is None:
+            verdicts[i] = True  # ScalarOutOfBounds: upheld
+            continue
+        coeffs = round1_by_sender[m.accused_index].committed_coefficients
+        recheck.append((i, accuser_idx, s, r, coeffs))
+    if recheck:
+        share_ok = check_randomized_shares_batch(
+            group,
+            cs,
+            ck,
+            [x[1] for x in recheck],
+            [x[2] for x in recheck],
+            [x[3] for x in recheck],
+            [x[4] for x in recheck],
+        )
+        for (i, *_), good in zip(recheck, share_ok):
+            verdicts[i] = not bool(good)  # upheld iff the check FAILS
+    return verdicts

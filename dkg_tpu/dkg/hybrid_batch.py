@@ -1,0 +1,132 @@
+"""Batched hybrid share encryption: device KEM + host DEM.
+
+Bridges the batched ceremony engine to the real wire protocol: the
+reference hybrid-encrypts each (share, hiding) pair per recipient inside
+the dealing loop (reference: committee.rs:163-186 → elgamal.rs:134-145).
+Here the KEM scalar-mults for *all* (dealer, recipient) pairs run as two
+batched device kernels:
+
+    c1[d, i]  = g·r[d, i]          (fixed-base table)
+    kem[d, i] = pk_i · r[d, i]     (batched variable-base)
+
+and only the byte-level tail (point compression -> Blake2b KDF ->
+ChaCha20) stays host-side, using the native C++ runtime when available
+(SURVEY §7 step 4: DEM off the hot path).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto.elgamal import (
+    PERSON_RAND,
+    PERSON_SHARE,
+    HybridCiphertext,
+    keystream_from_kem_bytes,
+)
+from ..fields import host as fh
+from ..groups import device as gd
+from ..groups import host as gh
+from .broadcast import BroadcastPhase1, EncryptedShares
+
+
+def _chacha():
+    try:
+        from .. import native
+
+        if native.available():
+            return native.chacha20_xor
+    except Exception:  # pragma: no cover
+        pass
+    from ..crypto.chacha import chacha20_xor
+
+    return chacha20_xor
+
+
+def kem_batch(cfg, pks_dev: jnp.ndarray, r_limbs: jnp.ndarray, g_table: jnp.ndarray):
+    """Device KEM for all pairs.
+
+    pks_dev  (n_recipients, C, L) — recipient communication public keys
+    r_limbs  (..., n_recipients, L) — fresh encryption randomness
+    returns (c1, kem), each (..., n_recipients, C, L).
+    """
+    cs = cfg.cs
+    c1 = gd.fixed_base_mul(cs, g_table, r_limbs)
+    kem = gd.scalar_mul(cs, r_limbs, jnp.broadcast_to(pks_dev, r_limbs.shape[:-1] + pks_dev.shape[-2:]))
+    return c1, kem
+
+
+def seal_shares(
+    group: gh.HostGroup,
+    cfg,
+    shares: np.ndarray,  # (n_dealers, n_recipients, L) scalar limbs
+    hidings: np.ndarray,
+    c1: np.ndarray,  # (n_dealers, n_recipients, C, L) from kem_batch
+    kem: np.ndarray,
+) -> list[list[tuple[HybridCiphertext, HybridCiphertext]]]:
+    """Host DEM: compress KEM points, KDF, stream-cipher the scalars.
+
+    The same KEM point seals both ciphertexts of a pair with distinct
+    KDF personalisation, matching one ElGamal exponentiation per
+    recipient on the device side.
+    """
+    xor = _chacha()
+    cs = cfg.cs
+    fs = cs.scalar
+    n_d, n_r = shares.shape[:2]
+    out = []
+    for d in range(n_d):
+        c1_pts = gd.to_host(cs, c1[d])
+        kem_pts = gd.to_host(cs, kem[d])
+        row = []
+        for i in range(n_r):
+            kem_bytes = group.encode(kem_pts[i])
+            e1 = c1_pts[i]
+            cts = []
+            for tag, limbs in ((PERSON_SHARE, shares[d, i]), (PERSON_RAND, hidings[d, i])):
+                key, nonce = keystream_from_kem_bytes(kem_bytes, tag)
+                msg = int(fh.decode_int(fs, limbs)).to_bytes(fs.nbytes, "little")
+                cts.append(HybridCiphertext(e1, xor(key, nonce, msg)))
+            row.append((cts[0], cts[1]))
+        out.append(row)
+    return out
+
+
+def open_share(
+    group: gh.HostGroup,
+    sk: int,
+    pair: tuple[HybridCiphertext, HybridCiphertext],
+) -> tuple[int | None, int | None]:
+    """Recipient-side decryption of a sealed (share, hiding) pair."""
+    xor = _chacha()
+    fs = group.scalar_field
+    share_ct, hiding_ct = pair
+    kem_bytes = group.encode(group.scalar_mul(sk, share_ct.e1))
+    out = []
+    for tag, ct in ((PERSON_SHARE, share_ct), (PERSON_RAND, hiding_ct)):
+        key, nonce = keystream_from_kem_bytes(kem_bytes, tag)
+        pt = xor(key, nonce, ct.ciphertext)
+        v = int.from_bytes(pt, "little") if len(pt) == fs.nbytes else None
+        out.append(v if v is None or v < fs.modulus else None)
+    return out[0], out[1]
+
+
+def broadcasts_from_batch(
+    group: gh.HostGroup,
+    cfg,
+    randomized: np.ndarray,  # (n_dealers, t+1, C, L)
+    sealed: list[list[tuple[HybridCiphertext, HybridCiphertext]]],
+) -> list[BroadcastPhase1]:
+    """Package device-dealt commitments + sealed shares as wire-format
+    BroadcastPhase1 messages, one per dealer."""
+    cs = cfg.cs
+    out = []
+    for d, row in enumerate(sealed):
+        coeffs = tuple(gd.to_host(cs, randomized[d]))
+        enc = tuple(
+            EncryptedShares(i + 1, share_ct, hiding_ct)
+            for i, (share_ct, hiding_ct) in enumerate(row)
+        )
+        out.append(BroadcastPhase1(coeffs, enc))
+    return out

@@ -16,7 +16,7 @@ from typing import Optional
 from ..crypto.elgamal import (
     HybridCiphertext,
     Keypair,
-    hybrid_decrypt,
+    open_pair,
 )
 from ..groups.host import HostGroup
 
@@ -83,8 +83,7 @@ def decrypt_shares(
     procedure_keys.rs:88-103 -> ScalarOutOfBounds handling
     committee.rs:318-331)."""
     fs = group.scalar_field
-    pt1 = hybrid_decrypt(group, sk.sk, share_ct)
-    pt2 = hybrid_decrypt(group, sk.sk, randomness_ct)
+    pt1, pt2 = open_pair(group, sk.sk, share_ct, randomness_ct)
     s = int.from_bytes(pt1, "little") if len(pt1) == fs.nbytes else None
     r = int.from_bytes(pt2, "little") if len(pt2) == fs.nbytes else None
     if s is not None and s >= fs.modulus:

@@ -326,14 +326,49 @@ def _gather_table(table: jax.Array, digit: jax.Array) -> jax.Array:
     return jnp.take_along_axis(table, idx, axis=-3)[..., 0, :, :]
 
 
-@_jit_static0
+def _canon_batch(n: int) -> int:
+    """Pad a flattened batch to the next power of two.
+
+    The ladder kernels compile slowly (hundreds of limb-mul steps in the
+    scan body); bucketing eager-call batch shapes to powers of two means
+    one compile per size class instead of one per distinct (n_d, n_r,
+    ...) combination.  Padding lanes carry k=0 / identity and are
+    dropped on return.
+    """
+    return 1 << (max(n, 1) - 1).bit_length()
+
+
 def scalar_mul(cs: CurveSpec, k: jax.Array, p: jax.Array) -> jax.Array:
     """Batched k·P: k (..., L) scalar limbs, p (..., C, L) points.
 
-    Fixed-window MSB-first double-and-add via lax.scan: no data-dependent
-    control flow (digit-0 adds the identity through the complete
-    formulas).  Replaces the reference's per-point dalek scalar mult
-    (reference: src/groups.rs:70-76) with one wide batched op.
+    Eager calls are flattened + power-of-two padded (see _canon_batch);
+    traced calls inline into the caller's graph untouched.
+    """
+    if isinstance(k, jax.core.Tracer) or isinstance(p, jax.core.Tracer):
+        return _scalar_mul_core(cs, k, p)
+    batch = k.shape[:-1]
+    if p.shape[:-2] != batch:
+        p = jnp.broadcast_to(p, batch + p.shape[-2:])
+    n = 1
+    for d in batch:
+        n *= int(d)
+    m = _canon_batch(n)
+    kf = jnp.reshape(k, (n, k.shape[-1]))
+    pf = jnp.reshape(p, (n,) + p.shape[-2:])
+    if m != n:
+        kf = jnp.concatenate([kf, jnp.zeros((m - n,) + kf.shape[1:], kf.dtype)])
+        pad_pt = jnp.broadcast_to(identity(cs, (m - n,)), (m - n,) + pf.shape[1:])
+        pf = jnp.concatenate([pf, pad_pt.astype(pf.dtype)])
+    out = _scalar_mul_core(cs, kf, pf)
+    return jnp.reshape(out[:n], batch + out.shape[-2:])
+
+
+@_jit_static0
+def _scalar_mul_core(cs: CurveSpec, k: jax.Array, p: jax.Array) -> jax.Array:
+    """Fixed-window MSB-first double-and-add via lax.scan: no
+    data-dependent control flow (digit-0 adds the identity through the
+    complete formulas).  Replaces the reference's per-point dalek scalar
+    mult (reference: src/groups.rs:70-76) with one wide batched op.
     """
     table = _build_table(cs, p)
     digits = scalar_windows(cs, k)  # (..., NW)
@@ -416,14 +451,30 @@ def fixed_base_table(cs: CurveSpec, base) -> jax.Array:
     return jnp.asarray(_fixed_table_np(cs, base_key(cs, base)))
 
 
-@_jit_static0
 def fixed_base_mul(cs: CurveSpec, table: jax.Array, k: jax.Array) -> jax.Array:
     """Batched k·B for fixed B: table (NW, 16, C, L), k (..., L).
 
     NW gathered adds, no doublings — the workhorse for coefficient
     commitments g·a + h·b (reference hot loop committee.rs:151-159) and
-    KEM first components g·r (reference: elgamal.rs:138-142).
+    KEM first components g·r (reference: elgamal.rs:138-142).  Eager
+    calls are flattened + power-of-two padded (see _canon_batch).
     """
+    if isinstance(k, jax.core.Tracer) or isinstance(table, jax.core.Tracer):
+        return _fixed_base_mul_core(cs, table, k)
+    batch = k.shape[:-1]
+    n = 1
+    for d in batch:
+        n *= int(d)
+    m = _canon_batch(n)
+    kf = jnp.reshape(k, (n, k.shape[-1]))
+    if m != n:
+        kf = jnp.concatenate([kf, jnp.zeros((m - n,) + kf.shape[1:], kf.dtype)])
+    out = _fixed_base_mul_core(cs, table, kf)
+    return jnp.reshape(out[:n], batch + out.shape[-2:])
+
+
+@_jit_static0
+def _fixed_base_mul_core(cs: CurveSpec, table: jax.Array, k: jax.Array) -> jax.Array:
     digits = scalar_windows(cs, k)  # (..., NW)
     sel = jnp.moveaxis(digits, -1, 0)  # (NW, ...)
 

@@ -1,0 +1,127 @@
+"""Batched Ristretto255 encode/decode on device (RFC 9496 §4.3.1-.2).
+
+Point (de)compression sits at every wire boundary (broadcast of
+commitments, KEM points for the DEM KDF).  The host path does it one
+point at a time (groups/host.py); these kernels compress/decompress
+whole tensors of points branchlessly — sqrt via a compile-time
+Fermat-style power, sign fixes via selects — so the batched engine never
+leaves the device until actual bytes are needed.
+
+Reference parity: dalek's compression, used by the reference through
+to_bytes/from_bytes (reference: src/traits.rs:230-232, groups.rs:77-82).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..fields import device as fd
+from ..fields import host as fh
+from . import host as gh
+from .device import RISTRETTO255, _stack, _unstack
+
+F = RISTRETTO255.field
+_SQRT_M1 = gh.SQRT_M1
+_INVSQRT_A_MINUS_D = gh.INVSQRT_A_MINUS_D
+_D = gh.D
+
+
+def _c(v: int) -> jax.Array:
+    return fd.constant(F, v)
+
+
+def _is_odd(x: jax.Array) -> jax.Array:
+    return (x[..., 0] & 1) != 0
+
+
+def _abs(x: jax.Array) -> jax.Array:
+    """Non-negative representative: negate when odd."""
+    return fd.select(_is_odd(x), fd.neg(F, x), x)
+
+
+def sqrt_ratio_m1(u: jax.Array, v: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Batched SQRT_RATIO_M1 (RFC 9496 §4.2): returns (was_square, root)."""
+    v2 = fd.square(F, v)
+    v3 = fd.mul(F, v2, v)
+    v7 = fd.mul(F, fd.square(F, v3), v)
+    uv3 = fd.mul(F, u, v3)
+    uv7 = fd.mul(F, u, v7)
+    r = fd.mul(F, uv3, fd.pow_const(F, uv7, (gh.P - 5) // 8))
+    check = fd.mul(F, v, fd.square(F, r))
+    u_neg = fd.neg(F, u)
+    correct = fd.eq(check, u)
+    flipped = fd.eq(check, u_neg)
+    flipped_i = fd.eq(check, fd.mul(F, u_neg, _c(_SQRT_M1)))
+    r = fd.select(flipped | flipped_i, fd.mul(F, r, _c(_SQRT_M1)), r)
+    return correct | flipped, _abs(r)
+
+
+@jax.jit
+def ristretto_encode_batch(pts: jax.Array) -> jax.Array:
+    """(..., 4, L) extended Edwards points -> (..., L) canonical s limbs."""
+    x0, y0, z0, t0 = _unstack(pts, 4)
+    u1 = fd.mul(F, fd.add(F, z0, y0), fd.sub(F, z0, y0))
+    u2 = fd.mul(F, x0, y0)
+    _, invsqrt = sqrt_ratio_m1(
+        jnp.broadcast_to(fd.ones(F), u1.shape), fd.mul(F, u1, fd.square(F, u2))
+    )
+    den1 = fd.mul(F, invsqrt, u1)
+    den2 = fd.mul(F, invsqrt, u2)
+    z_inv = fd.mul(F, fd.mul(F, den1, den2), t0)
+    ix0 = fd.mul(F, x0, _c(_SQRT_M1))
+    iy0 = fd.mul(F, y0, _c(_SQRT_M1))
+    enchanted = fd.mul(F, den1, _c(_INVSQRT_A_MINUS_D))
+    rotate = _is_odd(fd.mul(F, t0, z_inv))
+    x = fd.select(rotate, iy0, x0)
+    y = fd.select(rotate, ix0, y0)
+    den_inv = fd.select(rotate, enchanted, den2)
+    y = fd.select(_is_odd(fd.mul(F, x, z_inv)), fd.neg(F, y), y)
+    s = _abs(fd.mul(F, den_inv, fd.sub(F, z0, y)))
+    return s
+
+
+@jax.jit
+def ristretto_decode_batch(s: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """(..., L) candidate s limbs -> ((..., 4, L) points, (...,) valid).
+
+    Invalid encodings yield valid=False; their point lanes are garbage
+    and must be masked by the caller (branchless policy, like every
+    device op here).  Canonicality (s < p, s even) is part of the check.
+    """
+    # canonical range check: s < p
+    p_limbs = jnp.asarray(fh.encode(F, gh.P - 1))  # max valid value is p-1
+    # s <= p-1  <=>  (p-1) - s does not borrow
+    _, borrow = fd.sub_with_borrow(
+        jnp.broadcast_to(p_limbs, s.shape), s
+    )
+    canonical = (borrow == 0) & ~_is_odd(s)
+
+    ss = fd.square(F, s)
+    u1 = fd.sub(F, jnp.broadcast_to(fd.ones(F), ss.shape), ss)  # 1 - s^2
+    u2 = fd.add(F, jnp.broadcast_to(fd.ones(F), ss.shape), ss)  # 1 + s^2
+    u2_sqr = fd.square(F, u2)
+    # v = -(d * u1^2) - u2^2
+    v = fd.sub(F, fd.neg(F, fd.mul(F, _c(_D), fd.square(F, u1))), u2_sqr)
+    was_square, invsqrt = sqrt_ratio_m1(
+        jnp.broadcast_to(fd.ones(F), v.shape), fd.mul(F, v, u2_sqr)
+    )
+    den_x = fd.mul(F, invsqrt, u2)
+    den_y = fd.mul(F, fd.mul(F, invsqrt, den_x), v)
+    x = _abs(fd.mul(F, fd.add(F, s, s), den_x))
+    y = fd.mul(F, u1, den_y)
+    t = fd.mul(F, x, y)
+    valid = canonical & was_square & ~_is_odd(t) & ~fd.is_zero(y)
+    pts = _stack(x, y, jnp.broadcast_to(fd.ones(F), x.shape), t)
+    return pts, valid
+
+
+@functools.partial(jax.jit, static_argnums=1)
+def limbs_to_bytes_u8(s: jax.Array, nbytes: int = 32) -> jax.Array:
+    """(..., L) 16-bit limbs -> (..., nbytes) uint8 little-endian."""
+    lo = (s & 0xFF).astype(jnp.uint8)
+    hi = ((s >> 8) & 0xFF).astype(jnp.uint8)
+    inter = jnp.stack([lo, hi], axis=-1).reshape(s.shape[:-1] + (s.shape[-1] * 2,))
+    return inter[..., :nbytes]

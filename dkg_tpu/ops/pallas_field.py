@@ -1,0 +1,175 @@
+"""Pallas TPU kernel: batched modular multiply (Barrett) on 16-bit limbs.
+
+The single hottest primitive in the framework: every ladder step of
+every scalar multiplication (groups/device.py) bottoms out in
+``fields.device.mul`` — a schoolbook limb product plus Barrett
+reduction.  The XLA path materialises the (L, L) product grid and an
+antidiagonal contraction per multiply; this kernel instead keeps one
+(L, BLOCK) tile of each operand resident in VMEM and walks the
+schoolbook columns with fully unrolled VPU multiply-accumulates, with
+the batch axis riding the 128-wide lane dimension.
+
+Layout contract: limbs on the sublane axis, batch on the lane axis —
+the transpose of the (batch, L) layout used elsewhere; the ``mod_mul``
+wrapper handles the (cheap, fused) transposes and pads the batch to the
+block size.
+
+All constants (p, the Barrett mu, their extended forms) are baked into
+the kernel as Python-int immediates, so each field gets its own
+specialised program — mirroring how the reference's dalek backend bakes
+the curve25519 prime into field ops at compile time (reference:
+src/groups.rs:11-53 delegating to curve25519-dalek's fixed-prime field).
+
+Correctness invariants are the same as fields/device.py: limbs < 2**16
+in uint32 lanes, column accumulators <= 2*L terms of < 2**16 products'
+halves, Barrett remainder < 3p fixed by two conditional subtractions.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..fields.spec import FieldSpec, int_to_limbs
+
+BLOCK = 128  # lane width: one VPU register row of batch elements
+
+try:  # pallas import is deferred-safe: CPU-only environments still work
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    HAVE_PALLAS = False
+
+
+def _mul_columns(rows_a, rows_b):
+    """Schoolbook product columns of two unrolled limb-row lists.
+
+    rows_* are Python lists of (1, BLOCK) uint32 tiles with values
+    < 2**16.  Returns 2L unnormalised column tiles: col[c] =
+    sum_{i+j=c} lo(a_i b_j) + sum_{i+j=c-1} hi(a_i b_j) < 2**21·2.
+    """
+    la, lb = len(rows_a), len(rows_b)
+    cols = [None] * (la + lb)
+    for i in range(la):
+        for j in range(lb):
+            prod = rows_a[i] * rows_b[j]  # 16x16 -> 32, exact in uint32
+            lo = prod & jnp.uint32(0xFFFF)
+            hi = prod >> 16
+            c = i + j
+            cols[c] = lo if cols[c] is None else cols[c] + lo
+            cols[c + 1] = hi if cols[c + 1] is None else cols[c + 1] + hi
+    return [jnp.zeros_like(rows_a[0]) if c is None else c for c in cols]
+
+
+def _normalize(cols):
+    """Carry-propagate column tiles into 16-bit limb tiles (same length)."""
+    out = []
+    carry = jnp.zeros_like(cols[0])
+    for c in cols:
+        s = c + carry
+        out.append(s & jnp.uint32(0xFFFF))
+        carry = s >> 16
+    return out
+
+
+def _sub_with_borrow(rows_x, rows_y):
+    """Limbwise x - y with borrow chain; returns (rows, borrow_tile)."""
+    out = []
+    borrow = jnp.zeros_like(rows_x[0])
+    for xi, yi in zip(rows_x, rows_y):
+        s = xi - yi - borrow  # uint32 wraparound encodes the sign
+        out.append(s & jnp.uint32(0xFFFF))
+        borrow = s >> 31
+    return out, borrow
+
+
+def _cond_sub(rows_x, const_limbs):
+    """Branchless x - m if x >= m else x, m a Python-int limb list."""
+    rows_m = [jnp.full_like(rows_x[0], np.uint32(m)) for m in const_limbs]
+    diff, borrow = _sub_with_borrow(rows_x, rows_m)
+    keep = borrow != 0
+    return [jnp.where(keep, xi, di) for xi, di in zip(rows_x, diff)]
+
+
+def _make_kernel(fs: FieldSpec):
+    L = fs.limbs
+    mu = [int(v) for v in fs.barrett_mu]  # (L+1,) Python ints
+    p_ext = [int(v) for v in fs.p_limbs_ext]  # (L+1,)
+
+    def kernel(a_ref, b_ref, out_ref):
+        rows_a = [a_ref[i : i + 1, :] for i in range(L)]
+        rows_b = [b_ref[i : i + 1, :] for i in range(L)]
+        x = _normalize(_mul_columns(rows_a, rows_b))  # 2L limb tiles
+
+        # Barrett (HAC 14.42), base 2**16 — mirrors fields/device.py.
+        q1 = x[L - 1 :]  # L+1 tiles
+        mu_rows = [jnp.full_like(x[0], np.uint32(m)) for m in mu]
+        q2 = _normalize(_mul_columns(q1, mu_rows))
+        q3 = q2[L + 1 :]  # L+1 tiles
+        pe_rows = [jnp.full_like(x[0], np.uint32(m)) for m in p_ext]
+        r2 = _normalize(_mul_columns(q3, pe_rows))[: L + 1]
+        r1 = x[: L + 1]
+        r, _ = _sub_with_borrow(r1, r2)  # mod b**(L+1): r in [0, 3p)
+        r = _cond_sub(r, p_ext)
+        r = _cond_sub(r, p_ext)
+        for i in range(L):
+            out_ref[i : i + 1, :] = r[i]
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def _mod_mul_tiles(fs: FieldSpec, a_t: jax.Array, b_t: jax.Array, interpret: bool):
+    """(L, B) x (L, B) -> (L, B), B a multiple of BLOCK."""
+    L, B = a_t.shape
+    return pl.pallas_call(
+        _make_kernel(fs),
+        grid=(B // BLOCK,),
+        in_specs=[
+            pl.BlockSpec((L, BLOCK), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((L, BLOCK), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((L, BLOCK), lambda i: (0, i), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((L, B), jnp.uint32),
+        interpret=interpret,
+    )(a_t, b_t)
+
+
+def _want_interpret() -> bool:
+    """Mosaic only exists on real TPU backends; interpret elsewhere."""
+    return jax.default_backend() != "tpu"
+
+
+def mod_mul(fs: FieldSpec, a: jax.Array, b: jax.Array, *, interpret: bool | None = None) -> jax.Array:
+    """Batched (a * b) mod p via the Pallas kernel.
+
+    a, b: (..., L) uint32 limb arrays (the framework-wide layout); the
+    batch is flattened, padded to a BLOCK multiple, and mapped onto the
+    lane axis.  Drop-in parity with ``fields.device.mul``.
+    """
+    if not HAVE_PALLAS:  # pragma: no cover
+        from ..fields import device as fd
+
+        return fd.mul(fs, a, b)
+    a = jnp.asarray(a, jnp.uint32)
+    b = jnp.asarray(b, jnp.uint32)
+    a, b = jnp.broadcast_arrays(a, b)
+    batch = a.shape[:-1]
+    n = 1
+    for d in batch:
+        n *= int(d)
+    m = max(BLOCK, ((n + BLOCK - 1) // BLOCK) * BLOCK)
+    af = jnp.reshape(a, (n, fs.limbs))
+    bf = jnp.reshape(b, (n, fs.limbs))
+    if m != n:
+        pad = [(0, m - n), (0, 0)]
+        af = jnp.pad(af, pad)
+        bf = jnp.pad(bf, pad)
+    interp = _want_interpret() if interpret is None else interpret
+    out_t = _mod_mul_tiles(fs, af.T, bf.T, interp)
+    return jnp.reshape(out_t.T[:n], batch + (fs.limbs,))

@@ -1,0 +1,3 @@
+"""Multi-chip sharding (mesh + collectives at round boundaries)."""
+
+from . import mesh  # noqa: F401

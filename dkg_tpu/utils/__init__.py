@@ -1,0 +1,3 @@
+"""Cross-cutting utilities: serialization/checkpointing, tracing."""
+
+from . import serde, tracing  # noqa: F401

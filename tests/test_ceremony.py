@@ -138,3 +138,19 @@ def test_master_respects_qualified_mask(ceremony):
         gd.to_host(cfg.cs, np.asarray(master)[None])[0],
         g.scalar_mul(secret, g.generator()),
     )
+
+
+@pytest.mark.parametrize("curve", ["secp256k1", "bls12_381_g1"])
+def test_engine_other_curves_smoke(curve):
+    """Full engine round on the Weierstrass backends: same oracle as the
+    Ristretto fixture (master == g * sum of dealt secrets)."""
+    n, t = 3, 1
+    c = ce.BatchedCeremony(curve, n, t, b"engine-curve", RNG)
+    out = c.run(rho_bits=64)
+    assert bool(np.asarray(out["ok"]).all())
+    g = c.group
+    fs = c.cfg.cs.scalar
+    a = fh.decode(fs, np.asarray(c.coeffs_a))
+    secret = sum(int(row[0]) for row in a) % fs.modulus
+    master = gd.to_host(c.cfg.cs, np.asarray(out["master"])[None])[0]
+    assert g.eq(master, g.scalar_mul(secret, g.generator()))

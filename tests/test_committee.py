@@ -12,7 +12,8 @@ import random
 
 import pytest
 
-from dkg_tpu.crypto import hybrid_encrypt
+from dkg_tpu.crypto import hybrid_encrypt  # noqa: F401  (two-KEM layout probe)
+from dkg_tpu.crypto.elgamal import seal_pair
 from dkg_tpu.dkg import (
     BroadcastPhase1,
     DistributedKeyGeneration,
@@ -155,13 +156,18 @@ def test_misbehaving_dealer_disqualified():
     # party 3 deals a garbage share to party 1 (fault injection =
     # hand-corrupting broadcast data, reference committee.rs:1188)
     bad = b1[2]
-    garbage = G.scalar_to_bytes(G.random_scalar(RNG))
     tampered = list(bad.encrypted_shares)
     es = tampered[0]
     assert es.recipient_index == 1
-    tampered[0] = type(es)(
-        1, hybrid_encrypt(G, pks[0].point, garbage, RNG), es.randomness_ct
+    # a well-formed sealed pair whose scalars don't match the commitments
+    s_ct, r_ct = seal_pair(
+        G,
+        pks[0].point,
+        G.scalar_to_bytes(G.random_scalar(RNG)),
+        G.scalar_to_bytes(G.random_scalar(RNG)),
+        RNG,
     )
+    tampered[0] = type(es)(1, s_ct, r_ct)
     b1[2] = BroadcastPhase1(bad.committed_coefficients, tuple(tampered))
 
     fetched1 = lambda me: [
@@ -234,11 +240,14 @@ def test_all_malicious_aborts():
         bad = b1[j]
         tampered = list(bad.encrypted_shares)
         es = tampered[0]
-        tampered[0] = type(es)(
-            1,
-            hybrid_encrypt(G, pks[0].point, G.scalar_to_bytes(G.random_scalar(RNG)), RNG),
-            es.randomness_ct,
+        s_ct, r_ct = seal_pair(
+            G,
+            pks[0].point,
+            G.scalar_to_bytes(G.random_scalar(RNG)),
+            G.scalar_to_bytes(G.random_scalar(RNG)),
+            RNG,
         )
+        tampered[0] = type(es)(1, s_ct, r_ct)
         b1[j] = BroadcastPhase1(bad.committed_coefficients, tuple(tampered))
 
     fetched = [FetchedPhase1.from_broadcast(env, j + 1, b1[j]) for j in (1, 2)]

@@ -1,0 +1,77 @@
+"""Batched device dealing composed with the host protocol phases 2-5."""
+
+import random
+
+from dkg_tpu.dkg.committee import (
+    Environment,
+    FetchedComplaints2,
+    FetchedComplaints4,
+    FetchedPhase1,
+    FetchedPhase3,
+    FetchedPhase5,
+)
+from dkg_tpu.dkg.committee_batch import batched_dealing
+from dkg_tpu.dkg.procedure_keys import MemberCommunicationKey
+from dkg_tpu.groups import host as gh
+from dkg_tpu.poly.host import lagrange_interpolation
+
+RNG = random.Random(0xBA7D)
+G = gh.RISTRETTO255
+
+
+def test_batched_dealing_full_ceremony():
+    n, t = 4, 1
+    env = Environment.init(G, t, n, b"committee-batch")
+    keys = [MemberCommunicationKey.generate(G, RNG) for _ in range(n)]
+    dealt = batched_dealing(env, RNG, keys)
+    phases1 = [p for p, _ in dealt]
+    broadcasts = [b for _, b in dealt]
+
+    # round 2: everyone verifies everyone's shares — no complaints
+    phases2 = []
+    for i, p in enumerate(phases1):
+        fetched = [
+            FetchedPhase1.from_broadcast(env, j + 1, broadcasts[j]) for j in range(n)
+        ]
+        nxt, cb = p.proceed(fetched, RNG)
+        assert cb is None, "honest batched dealing must produce no complaints"
+        phases2.append(nxt)
+
+    # rounds 3-5, happy path
+    phases3, b3 = [], []
+    for p in phases2:
+        nxt, b = p.proceed([FetchedComplaints2(i + 1, None) for i in range(n)],
+                           [FetchedPhase1.from_broadcast(env, j + 1, broadcasts[j]) for j in range(n)])
+        phases3.append(nxt)
+        b3.append(b)
+    phases4 = []
+    for p in phases3:
+        nxt, b = p.proceed([FetchedPhase3.from_broadcast(env, j + 1, b3[j]) for j in range(n)])
+        assert b is None
+        phases4.append(nxt)
+    phases5 = []
+    for p in phases4:
+        nxt, b = p.proceed([FetchedComplaints4(i + 1, None) for i in range(n)])
+        assert b is None
+        phases5.append(nxt)
+
+    results = [p.finalise([FetchedPhase5(i + 1, None) for i in range(n)])[0] for p in phases5]
+    masters = [m for m, _ in results]
+    shares = [s.value for _, s in results]
+    for m in masters[1:]:
+        assert G.eq(m.point, masters[0].point)
+    # interpolating t+1 final shares reproduces the master secret
+    fs = G.scalar_field
+    secret = lagrange_interpolation(fs, 0, shares[: t + 1], list(range(1, t + 2)))
+    assert G.eq(masters[0].point, G.scalar_mul(secret, G.generator()))
+
+
+def test_batched_dealing_subset_matches_init_shape():
+    n, t = 3, 1
+    env = Environment.init(G, t, n, b"committee-batch-2")
+    keys = [MemberCommunicationKey.generate(G, RNG) for _ in range(n)]
+    dealt = batched_dealing(env, RNG, keys, members=[2])
+    assert len(dealt) == 1
+    _, b = dealt[0]
+    assert len(b.committed_coefficients) == t + 1
+    assert len(b.encrypted_shares) == n

@@ -1,0 +1,88 @@
+"""Batched complaint adjudication == serial MisbehavingPartiesRound1.verify."""
+
+import random
+from dataclasses import replace
+
+import numpy as np
+
+from dkg_tpu.crypto.commitment import CommitmentKey
+from dkg_tpu.dkg import complaints_batch as cb
+from dkg_tpu.dkg.broadcast import (
+    EncryptedShares,
+    MisbehavingPartiesRound1,
+    ProofOfMisbehaviour,
+)
+from dkg_tpu.dkg.committee import DistributedKeyGeneration, Environment, FetchedPhase1
+from dkg_tpu.dkg.errors import DkgErrorKind
+from dkg_tpu.dkg.procedure_keys import MemberCommunicationKey, sort_committee
+from dkg_tpu.groups import device as gd
+from dkg_tpu.groups import host as gh
+
+RNG = random.Random(0xC0817)
+G = gh.RISTRETTO255
+CS = gd.RISTRETTO255
+
+
+def _setup(n=4, t=1):
+    env = Environment.init(G, t, n, b"complaints-batch")
+    keys = [MemberCommunicationKey.generate(G, RNG) for _ in range(n)]
+    pks = sort_committee(G, [k.public() for k in keys])
+    by_pk = {G.encode(k.public().point): k for k in keys}
+    keys = [by_pk[G.encode(p.point)] for p in pks]  # sorted order
+    phases, broadcasts = [], []
+    for my in range(1, n + 1):
+        ph, b = DistributedKeyGeneration.init(env, RNG, keys[my - 1], [k.public() for k in keys], my)
+        phases.append(ph)
+        broadcasts.append(b)
+    return env, keys, pks, phases, broadcasts
+
+
+def _tamper_share(b, recipient):
+    """Flip a byte of the payload addressed to ``recipient``."""
+    es = list(b.encrypted_shares)
+    old = es[recipient - 1]
+    bad_ct = replace(old.share_ct, ciphertext=bytes([old.share_ct.ciphertext[0] ^ 1]) + old.share_ct.ciphertext[1:])
+    es[recipient - 1] = EncryptedShares(old.recipient_index, bad_ct, old.randomness_ct)
+    return replace(b, encrypted_shares=tuple(es))
+
+
+def test_batch_matches_serial_verdicts():
+    env, keys, pks, phases, broadcasts = _setup()
+    # dealer 2 sends party 1 a corrupted share
+    broadcasts[1] = _tamper_share(broadcasts[1], 1)
+
+    fetched = [FetchedPhase1.from_broadcast(env, j + 1, broadcasts[j]) for j in range(4)]
+    nxt, complaint_b = phases[0].proceed(fetched, RNG)
+    assert complaint_b is not None and len(complaint_b.misbehaving_parties) == 1
+    genuine = complaint_b.misbehaving_parties[0]
+    assert genuine.accused_index == 2
+
+    # a false accusation against honest dealer 3 by party 1
+    shares3 = broadcasts[2].shares_for(1)
+    false_proof = ProofOfMisbehaviour.generate(G, shares3, keys[0], RNG)
+    false_c = MisbehavingPartiesRound1(3, DkgErrorKind.SHARE_VALIDITY_FAILED, false_proof)
+
+    # a complaint against an index that never dealt
+    ghost_c = MisbehavingPartiesRound1(4, DkgErrorKind.SHARE_VALIDITY_FAILED, false_proof)
+
+    triples = [
+        (1, pks[0], genuine),
+        (1, pks[0], false_c),
+        (1, pks[0], ghost_c),
+    ]
+    by_sender = {1: broadcasts[0], 2: broadcasts[1], 3: broadcasts[2]}  # 4 missing
+
+    serial = [
+        m.verify(G, env.commitment_key, acc_i, acc_pk, by_sender[m.accused_index])
+        if m.accused_index in by_sender
+        else False
+        for acc_i, acc_pk, m in triples
+    ]
+    batch = cb.adjudicate_round1_batch(G, CS, env.commitment_key, triples, by_sender)
+    assert batch == serial == [True, False, False]
+
+
+def test_check_randomized_shares_batch_empty():
+    ck = CommitmentKey.generate(G, b"x")
+    out = cb.check_randomized_shares_batch(G, CS, ck, [], [], [], [])
+    assert out.shape == (0,)

@@ -1,0 +1,49 @@
+"""Batched device DLEQ vs the host prover/verifier."""
+
+import random
+
+import numpy as np
+
+from dkg_tpu.crypto.dleq import DleqZkp
+from dkg_tpu.crypto import dleq_batch as db
+from dkg_tpu.groups import device as gd
+from dkg_tpu.groups import host as gh
+
+RNG = random.Random(0xD1E0)
+G = gh.RISTRETTO255
+CS = gd.RISTRETTO255
+
+
+def _statements(k):
+    out = []
+    for _ in range(k):
+        x = G.random_scalar(RNG)
+        b1 = G.scalar_mul(G.random_scalar(RNG), G.generator())
+        b2 = G.scalar_mul(G.random_scalar(RNG), G.generator())
+        out.append((b1, b2, G.scalar_mul(x, b1), G.scalar_mul(x, b2), x))
+    return out
+
+
+def test_generate_batch_verifies_on_host():
+    stmts = _statements(3)
+    proofs = db.generate_batch(G, CS, stmts, RNG)
+    for proof, (b1, b2, h1, h2, _) in zip(proofs, stmts):
+        assert proof.verify(G, b1, b2, h1, h2)
+
+
+def test_verify_batch_accepts_host_proofs_rejects_tampered():
+    stmts = _statements(4)
+    proofs = [
+        DleqZkp.generate(G, b1, b2, h1, h2, x, RNG)
+        for (b1, b2, h1, h2, x) in stmts
+    ]
+    # tamper with proof 2's response
+    bad = DleqZkp(proofs[2].challenge, (proofs[2].response + 1) % G.scalar_field.modulus)
+    proofs = proofs[:2] + [bad] + proofs[3:]
+    ok = db.verify_batch(G, CS, proofs, [s[:4] for s in stmts])
+    assert ok.tolist() == [True, True, False, True]
+
+
+def test_empty_batch():
+    assert db.generate_batch(G, CS, [], RNG) == []
+    assert db.verify_batch(G, CS, [], []).shape == (0,)

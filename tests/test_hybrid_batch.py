@@ -1,0 +1,122 @@
+"""Device-KEM + host-DEM batch encryption round-trips."""
+
+import random
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from dkg_tpu.crypto import Keypair
+from dkg_tpu.dkg import ceremony as ce
+from dkg_tpu.dkg import hybrid_batch as hb
+from dkg_tpu.fields import host as fh
+from dkg_tpu.groups import device as gd
+from dkg_tpu.groups import host as gh
+
+RNG = random.Random(0x48B)
+
+
+def test_kem_seal_open_roundtrip():
+    curve = "ristretto255"
+    n_d, n_r, t = 3, 4, 1
+    g = gh.ALL_GROUPS[curve]
+    cfg = ce.CeremonyConfig(curve, n_r, t)
+    cs = cfg.cs
+    fs = cs.scalar
+
+    keys = [Keypair.generate(g, RNG) for _ in range(n_r)]
+    pks_dev = gd.from_host(cs, [k.pk for k in keys])
+
+    shares = np.asarray(
+        fh.encode(fs, [[fs.rand_int(RNG) for _ in range(n_r)] for _ in range(n_d)])
+    )
+    hidings = np.asarray(
+        fh.encode(fs, [[fs.rand_int(RNG) for _ in range(n_r)] for _ in range(n_d)])
+    )
+    r = jnp.asarray(
+        fh.encode(fs, [[fs.rand_int(RNG) for _ in range(n_r)] for _ in range(n_d)])
+    )
+
+    c = ce.BatchedCeremony(curve, n_r, t, b"hb", RNG)
+    c1, kem = hb.kem_batch(cfg, pks_dev, r, c.g_table)
+    # KEM correctness: kem[d,i] == pk_i * r[d,i] == sk_i * c1[d,i]
+    kem_host = gd.to_host(cs, np.asarray(kem).reshape(-1, cs.ncoords, cs.field.limbs))
+    c1_host = gd.to_host(cs, np.asarray(c1).reshape(-1, cs.ncoords, cs.field.limbs))
+    for d in range(n_d):
+        for i in range(n_r):
+            idx = d * n_r + i
+            assert g.eq(kem_host[idx], g.scalar_mul(keys[i].sk, c1_host[idx]))
+
+    sealed = hb.seal_shares(g, cfg, shares, hidings, np.asarray(c1), np.asarray(kem))
+    for d in range(n_d):
+        for i in range(n_r):
+            s, h = hb.open_share(g, keys[i].sk, sealed[d][i])
+            assert s == fh.decode_int(fs, shares[d, i])
+            assert h == fh.decode_int(fs, hidings[d, i])
+    # wrong key fails to produce the right scalar
+    s_bad, _ = hb.open_share(g, keys[0].sk, sealed[0][1])
+    assert s_bad != fh.decode_int(fs, shares[0, 1])
+
+
+def test_broadcasts_from_batch_shape():
+    curve = "ristretto255"
+    n, t = 4, 1
+    g = gh.ALL_GROUPS[curve]
+    c = ce.BatchedCeremony(curve, n, t, b"hb2", RNG)
+    cfg = c.cfg
+    fs = cfg.cs.scalar
+    a, e, s, r = ce.deal(cfg, c.coeffs_a, c.coeffs_b, c.g_table, c.h_table)
+    keys = [Keypair.generate(g, RNG) for _ in range(n)]
+    pks_dev = gd.from_host(cfg.cs, [k.pk for k in keys])
+    renc = jnp.asarray(
+        fh.encode(fs, [[fs.rand_int(RNG) for _ in range(n)] for _ in range(n)])
+    )
+    c1, kem = hb.kem_batch(cfg, pks_dev, renc, c.g_table)
+    sealed = hb.seal_shares(
+        g, cfg, np.asarray(s), np.asarray(r), np.asarray(c1), np.asarray(kem)
+    )
+    bs = hb.broadcasts_from_batch(g, cfg, np.asarray(e), sealed)
+    assert len(bs) == n
+    assert len(bs[0].committed_coefficients) == t + 1
+    assert bs[0].encrypted_shares[2].recipient_index == 3
+    # recipient can open its sealed share from the wire message
+    s0, h0 = hb.open_share(
+        g,
+        keys[2].sk,
+        (bs[1].encrypted_shares[2].share_ct, bs[1].encrypted_shares[2].randomness_ct),
+    )
+    from dkg_tpu.fields import host as fhh
+
+    assert s0 == fhh.decode_int(fs, np.asarray(s)[1, 2])
+
+
+def test_batched_sealing_interops_with_committee_decrypt():
+    """Device-sealed pairs open through the wire-protocol path
+    (procedure_keys.decrypt_shares) — one KEM point, two KDF tags."""
+    from dkg_tpu.dkg.procedure_keys import MemberCommunicationKey, decrypt_shares
+
+    curve = "ristretto255"
+    n, t = 3, 1
+    g = gh.ALL_GROUPS[curve]
+    c = ce.BatchedCeremony(curve, n, t, b"hb3", RNG)
+    cfg = c.cfg
+    fs = cfg.cs.scalar
+    a, e, s, r = ce.deal(cfg, c.coeffs_a, c.coeffs_b, c.g_table, c.h_table)
+    comm_keys = [MemberCommunicationKey.generate(g, RNG) for _ in range(n)]
+    pks_dev = gd.from_host(cfg.cs, [k.public().point for k in comm_keys])
+    renc = jnp.asarray(
+        fh.encode(fs, [[fs.rand_int(RNG) for _ in range(n)] for _ in range(n)])
+    )
+    c1, kem = hb.kem_batch(cfg, pks_dev, renc, c.g_table)
+    sealed = hb.seal_shares(
+        g, cfg, np.asarray(s), np.asarray(r), np.asarray(c1), np.asarray(kem)
+    )
+    bs = hb.broadcasts_from_batch(g, cfg, np.asarray(e), sealed)
+    for d in range(n):
+        for i in range(n):
+            es = bs[d].encrypted_shares[i]
+            got_s, got_r = decrypt_shares(
+                g, comm_keys[i], es.share_ct, es.randomness_ct
+            )
+            assert got_s == fh.decode_int(fs, np.asarray(s)[d, i])
+            assert got_r == fh.decode_int(fs, np.asarray(r)[d, i])

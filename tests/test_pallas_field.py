@@ -1,0 +1,61 @@
+"""Pallas modular-multiply kernel vs the host oracle and the XLA path.
+
+Runs in interpret mode on the CPU test mesh; the same program lowers to
+Mosaic on a real TPU backend.  The 24-limb BLS base field's
+interpret-mode compile is pathologically slow on CPU (the kernel unrolls
+~3L^2 ops), so wide fields are gated behind DKG_TPU_SLOW_TESTS=1; on a
+real TPU backend every field runs.
+"""
+
+import os
+import random
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from dkg_tpu.fields import device as fd
+from dkg_tpu.fields import host as fh
+from dkg_tpu.fields.spec import ALL_FIELDS
+from dkg_tpu.ops import pallas_field as pf
+
+RNG = random.Random(0xA11A5)
+
+RUN_WIDE = (
+    os.environ.get("DKG_TPU_SLOW_TESTS") == "1" or jax.default_backend() == "tpu"
+)
+
+
+def _fields_under_test():
+    return {
+        name: fs
+        for name, fs in ALL_FIELDS.items()
+        if RUN_WIDE or fs.limbs <= 16
+    }
+
+
+def _cases(fs, k):
+    return [RNG.randrange(fs.modulus) for _ in range(k)]
+
+
+def test_mod_mul_matches_host_all_fields():
+    for name, fs in _fields_under_test().items():
+        xs = _cases(fs, 5) + [0, 1, fs.modulus - 1]
+        ys = _cases(fs, 5) + [fs.modulus - 1, fs.modulus - 1, fs.modulus - 1]
+        a = jnp.asarray(fh.encode(fs, xs))
+        b = jnp.asarray(fh.encode(fs, ys))
+        got = fh.decode(fs, np.asarray(pf.mod_mul(fs, a, b)))
+        for g, x, y in zip(got, xs, ys):
+            assert int(g) == x * y % fs.modulus, name
+
+
+def test_mod_mul_matches_xla_path_batched():
+    fs = next(iter(ALL_FIELDS.values()))
+    xs = _cases(fs, 200)
+    ys = _cases(fs, 200)
+    a = jnp.asarray(fh.encode(fs, xs)).reshape(8, 25, fs.limbs)
+    b = jnp.asarray(fh.encode(fs, ys)).reshape(8, 25, fs.limbs)
+    got = np.asarray(pf.mod_mul(fs, a, b))
+    want = np.asarray(fd.mul(fs, a, b))
+    assert (got == want).all()

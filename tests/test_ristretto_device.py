@@ -1,0 +1,58 @@
+"""Batched device Ristretto encode/decode vs the host RFC 9496 oracle."""
+
+import random
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from dkg_tpu.groups import device as gd
+from dkg_tpu.groups import host as gh
+from dkg_tpu.groups import ristretto_device as rd
+
+RNG = random.Random(0x215)
+G = gh.RISTRETTO255
+
+
+def test_encode_batch_matches_host():
+    pts = [G.scalar_mul(G.random_scalar(RNG), G.generator()) for _ in range(6)]
+    pts.append(G.identity())
+    dev = gd.from_host(gd.RISTRETTO255, pts)
+    s = np.asarray(rd.ristretto_encode_batch(dev))
+    by = np.asarray(rd.limbs_to_bytes_u8(jnp.asarray(s), 32))
+    for i, p in enumerate(pts):
+        assert bytes(by[i].tolist()) == G.encode(p)
+
+
+def test_decode_batch_matches_host():
+    pts = [G.scalar_mul(G.random_scalar(RNG), G.generator()) for _ in range(5)]
+    encs = [G.encode(p) for p in pts]
+    # limb-ify the encodings
+    from dkg_tpu.fields import host as fh
+
+    s = jnp.asarray(fh.encode(gd.RISTRETTO255.field, [int.from_bytes(e, "little") for e in encs]))
+    dec, valid = rd.ristretto_decode_batch(s)
+    assert np.asarray(valid).all()
+    host_pts = gd.to_host(gd.RISTRETTO255, np.asarray(dec))
+    for a, b in zip(host_pts, pts):
+        assert G.eq(a, b)
+
+
+def test_decode_batch_rejects_invalid():
+    from dkg_tpu.fields import host as fh
+
+    # candidates: non-canonical (>= p), odd, and a few small even values
+    # whose validity we take from the host decoder as ground truth.
+    # NB: raw limbs via int_to_limbs, NOT fh.encode (which reduces mod p
+    # and would silently canonicalise the >= p candidate).
+    bad_vals = [gh.P, 1, 4, 2, 6]
+    s = jnp.asarray(
+        np.stack([fh.int_to_limbs(v % (1 << 255), gd.RISTRETTO255.field.limbs) for v in bad_vals])
+    )
+    _, valid = rd.ristretto_decode_batch(s)
+    got = np.asarray(valid)
+    expect = []
+    for v in bad_vals:
+        enc = int(v % (1 << 255)).to_bytes(32, "little")
+        expect.append(gh.RISTRETTO255.decode(enc) is not None and v < gh.P)
+    assert got.tolist() == expect
