@@ -17,6 +17,7 @@ value/209715 > 1 means the verification round is on budget.
 from __future__ import annotations
 
 import json
+import os
 import random
 import sys
 import time
@@ -89,6 +90,7 @@ def main():
                             "platform": platform,
                             "deal_s": round(t_deal, 3),
                             "verify_s": round(t_verify, 3),
+                            "pallas": os.environ.get("DKG_TPU_PALLAS") == "1",
                         },
                     }
                 )

@@ -14,6 +14,6 @@ reference Rust crate `dkg`, see SURVEY.md), redesigned TPU-first:
 * participant-axis sharding over a device mesh (``dkg_tpu.parallel``).
 """
 
-from dkg_tpu import crypto, dkg, fields, groups, ops, parallel, poly, utils  # noqa: F401
+from dkg_tpu import crypto, dkg, fields, groups, net, ops, parallel, poly, utils  # noqa: F401
 
 __version__ = "0.1.0"

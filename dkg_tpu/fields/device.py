@@ -28,6 +28,7 @@ Overflow discipline (the invariants that make this correct):
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -37,6 +38,12 @@ from jax import lax
 from .spec import FieldSpec
 
 MASK16 = jnp.uint32(0xFFFF)
+
+# Opt-in Pallas path for the modular multiply (ops/pallas_field.py).
+# Static at import: the dispatch must not introduce traced control flow.
+# Only sensible on a real TPU backend (Mosaic); interpret mode inside
+# the big ladder scans would be pathologically slow on CPU.
+_USE_PALLAS = os.environ.get("DKG_TPU_PALLAS") == "1"
 
 
 def _u32(x) -> jax.Array:
@@ -193,6 +200,10 @@ def neg(fs: FieldSpec, a: jax.Array) -> jax.Array:
 
 
 def mul(fs: FieldSpec, a: jax.Array, b: jax.Array) -> jax.Array:
+    if _USE_PALLAS:
+        from ..ops import pallas_field
+
+        return pallas_field.mod_mul(fs, a, b)
     return barrett_reduce(fs, mul_wide(a, b))
 
 

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -40,6 +41,10 @@ from ..fields.spec import FieldSpec
 from . import host as gh
 
 WINDOW = 4  # window bits for scalar decomposition (16-entry tables)
+
+# Opt-in fused Pallas point kernels (see ops/pallas_point.py); static at
+# import so the scan bodies trace to a fixed program.
+_USE_PALLAS = os.environ.get("DKG_TPU_PALLAS") == "1"
 
 
 def _jit_static0(fn):
@@ -293,15 +298,22 @@ def select(pred: jax.Array, p: jax.Array, q: jax.Array) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
-def scalar_windows(cs: CurveSpec, k: jax.Array) -> jax.Array:
-    """(..., L) scalar limbs -> (..., NW) 4-bit digits, little-endian."""
-    shifts = jnp.arange(0, 16, WINDOW, dtype=jnp.uint32)  # (4,)
-    digits = (k[..., :, None] >> shifts) & jnp.uint32(0xF)  # (..., L, 4)
-    return digits.reshape(k.shape[:-1] + (k.shape[-1] * (16 // WINDOW),))
+def scalar_windows(cs: CurveSpec, k: jax.Array, window: int = WINDOW) -> jax.Array:
+    """(..., L) scalar limbs -> (..., NW) window-bit digits, little-endian.
+
+    ``window`` must divide 16 (the limb width): 4 for per-lane tables
+    (variable base), 8 for host-precomputed fixed-base tables.
+    """
+    shifts = jnp.arange(0, 16, window, dtype=jnp.uint32)
+    digits = (k[..., :, None] >> shifts) & jnp.uint32((1 << window) - 1)
+    return digits.reshape(k.shape[:-1] + (k.shape[-1] * (16 // window),))
 
 
-def _n_windows(cs: CurveSpec) -> int:
-    return cs.scalar.limbs * (16 // WINDOW)
+FIXED_WINDOW = 8  # fixed-base tables: 256-entry windows, half the adds
+
+
+def _n_windows(cs: CurveSpec, window: int = WINDOW) -> int:
+    return cs.scalar.limbs * (16 // window)
 
 
 # ---------------------------------------------------------------------------
@@ -369,15 +381,25 @@ def _scalar_mul_core(cs: CurveSpec, k: jax.Array, p: jax.Array) -> jax.Array:
     data-dependent control flow (digit-0 adds the identity through the
     complete formulas).  Replaces the reference's per-point dalek scalar
     mult (reference: src/groups.rs:70-76) with one wide batched op.
+
+    With DKG_TPU_PALLAS=1 on an Edwards curve, the scan body's
+    4-double+add window collapses into ONE fused Pallas kernel launch
+    (ops.pallas_point.ed_window_step) — intermediates never touch HBM.
     """
     table = _build_table(cs, p)
     digits = scalar_windows(cs, k)  # (..., NW)
     digits_rev = jnp.moveaxis(digits, -1, 0)[::-1]  # MSB first
+    fused = _USE_PALLAS and cs.kind == "edwards"
+    if fused:
+        from ..ops import pallas_point
 
     def step(acc, dig):
+        entry = _gather_table(table, dig)
+        if fused:
+            return pallas_point.ed_window_step(cs, acc, entry, WINDOW), None
         for _ in range(WINDOW):
             acc = double(cs, acc)
-        return add(cs, acc, _gather_table(table, dig)), None
+        return add(cs, acc, entry), None
 
     init = identity(cs, p.shape[:-2])
     acc, _ = lax.scan(step, init, digits_rev)
@@ -390,23 +412,26 @@ def _scalar_mul_core(cs: CurveSpec, k: jax.Array, p: jax.Array) -> jax.Array:
 
 
 @functools.lru_cache(maxsize=32)
-def _fixed_table_np(cs: CurveSpec, base_key: tuple) -> np.ndarray:
-    """Host-computed window table for a fixed base: (NW, 16, C, L).
+def _fixed_table_np(cs: CurveSpec, base_key: tuple, window: int = FIXED_WINDOW) -> np.ndarray:
+    """Host-computed window table for a fixed base: (NW, 2**window, C, L).
 
-    T[w][d] = d · 16^w · B.  Stored affine-normalised (Z=1) so gathered
-    entries are cheap to add.  Cached per (curve, base).
+    T[w][d] = d · (2**window)^w · B.  Stored affine-normalised (Z=1) so
+    gathered entries are cheap to add.  Cached per (curve, base, window).
+    8-bit windows halve the device adds vs 4-bit at 2 MB/base of table —
+    a clear trade on TPU where the gather is cheap and HBM is plentiful.
     """
     host_group = gh.ALL_GROUPS[cs.name]
     base = base_key_to_point(cs, base_key)
-    nw = _n_windows(cs)
-    out = np.zeros((nw, 16, cs.ncoords, cs.field.limbs), dtype=np.uint32)
+    nw = _n_windows(cs, window)
+    entries = 1 << window
+    out = np.zeros((nw, entries, cs.ncoords, cs.field.limbs), dtype=np.uint32)
     window_base = base
     for w in range(nw):
         acc = host_group.identity()
-        for d in range(16):
+        for d in range(entries):
             out[w, d] = _affine_limbs(cs, host_group, acc)
             acc = host_group.add(acc, window_base)
-        for _ in range(WINDOW):
+        for _ in range(window):
             window_base = host_group.add(window_base, window_base)
     return out
 
@@ -475,11 +500,14 @@ def fixed_base_mul(cs: CurveSpec, table: jax.Array, k: jax.Array) -> jax.Array:
 
 @_jit_static0
 def _fixed_base_mul_core(cs: CurveSpec, table: jax.Array, k: jax.Array) -> jax.Array:
-    digits = scalar_windows(cs, k)  # (..., NW)
+    # window width is encoded in the table's entry count (16 -> 4-bit,
+    # 256 -> 8-bit); both divide the 16-bit limb width.
+    window = int(table.shape[1]).bit_length() - 1
+    digits = scalar_windows(cs, k, window)  # (..., NW)
     sel = jnp.moveaxis(digits, -1, 0)  # (NW, ...)
 
     def step(acc, args):
-        tab_w, dig = args  # (16, C, L), (...)
+        tab_w, dig = args  # (2**window, C, L), (...)
         entry = _gather_table(tab_w, dig)
         return add(cs, acc, entry), None
 

@@ -1,0 +1,18 @@
+"""Host-side broadcast-channel backends + channel-driven party runner.
+
+The reference deliberately has no communication layer: the protocol
+assumes an external authenticated broadcast channel ("the blockchain",
+reference src/lib.rs:91-92) and its tests pass message arrays by hand
+(committee.rs:1337-1338).  This package supplies that missing piece as
+a first-class subsystem: an abstract ``BroadcastChannel``, an
+in-process implementation (the reference's test style, made explicit),
+a TCP hub for real multi-process ceremonies, and ``run_party`` — the
+full 5-phase protocol driven over a channel with the deterministic wire
+encoding from utils.serde.
+
+Device-mesh ceremonies (dkg_tpu.parallel) ride ICI/DCN collectives
+instead; this layer is the host-side external-world boundary.
+"""
+
+from .channel import BroadcastChannel, InProcessChannel, TcpHub, TcpHubChannel  # noqa: F401
+from .party import PartyResult, run_party  # noqa: F401

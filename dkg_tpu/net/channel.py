@@ -1,0 +1,154 @@
+"""Broadcast channels: publish-once / fetch-all per round.
+
+Semantics mirror the reference's abstract channel (reference:
+src/lib.rs:91-92, committee.rs:825-871): every party publishes at most
+one message per round; everyone then fetches the full round.  A party
+with nothing to say publishes the empty payload (the protocol's
+``None`` broadcast); a party that never publishes is simply absent from
+the fetch — both map to silent disqualification downstream.
+
+``TcpHub`` is a minimal length-prefixed TCP mailbox for multi-process
+ceremonies; authenticity/transport security is the deployment's job,
+exactly as the reference assumes an *authenticated* channel.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Optional, Protocol
+
+_OP_PUB = 1
+_OP_FETCH = 2
+
+
+class BroadcastChannel(Protocol):
+    def publish(self, round_no: int, sender: int, payload: bytes) -> None:
+        """Publish this party's round message (empty = explicit no-op)."""
+
+    def fetch(
+        self, round_no: int, expected: int, timeout: float = 30.0
+    ) -> dict[int, bytes]:
+        """Block until ``expected`` messages for the round arrived (or
+        timeout); returns {sender_index: payload}.  On timeout returns
+        whatever arrived — missing parties become silent dropouts."""
+
+
+class InProcessChannel:
+    """Shared-memory channel for in-process multi-party simulation —
+    the reference's test transport (committee.rs:1337-1338) with real
+    blocking semantics so threaded parties interleave correctly."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Condition()
+        self._rounds: dict[int, dict[int, bytes]] = {}
+
+    def publish(self, round_no: int, sender: int, payload: bytes) -> None:
+        with self._lock:
+            self._rounds.setdefault(round_no, {})[sender] = payload
+            self._lock.notify_all()
+
+    def fetch(self, round_no: int, expected: int, timeout: float = 30.0) -> dict[int, bytes]:
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while True:
+                got = self._rounds.get(round_no, {})
+                if len(got) >= expected:
+                    return dict(got)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return dict(got)
+                self._lock.wait(remaining)
+
+
+class _HubHandler(socketserver.StreamRequestHandler):
+    def handle(self) -> None:  # one request per connection
+        hub: "TcpHub" = self.server.hub  # type: ignore[attr-defined]
+        try:
+            op = _read_exact(self.rfile, 1)[0]
+            if op == _OP_PUB:
+                round_no, sender, ln = struct.unpack("<III", _read_exact(self.rfile, 12))
+                payload = _read_exact(self.rfile, ln)
+                hub.channel.publish(round_no, sender, payload)
+                self.wfile.write(b"\x01")
+            elif op == _OP_FETCH:
+                round_no, expected, timeout_ms = struct.unpack(
+                    "<III", _read_exact(self.rfile, 12)
+                )
+                got = hub.channel.fetch(round_no, expected, timeout_ms / 1000.0)
+                out = [struct.pack("<I", len(got))]
+                for sender, payload in sorted(got.items()):
+                    out.append(struct.pack("<II", sender, len(payload)))
+                    out.append(payload)
+                self.wfile.write(b"".join(out))
+        except (ConnectionError, EOFError):
+            pass
+
+
+def _read_exact(f, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = f.read(n - len(buf))
+        if not chunk:
+            raise EOFError
+        buf += chunk
+    return buf
+
+
+class TcpHub:
+    """The mailbox server: one per ceremony, any party (or a neutral
+    host) can run it.  Threaded: each publish/fetch is one connection."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self.channel = InProcessChannel()
+        self._server = _Server((host, port), _HubHandler)
+        self._server.hub = self  # type: ignore[attr-defined]
+        self.address = self._server.server_address
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+
+    def start(self) -> "TcpHub":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class TcpHubChannel:
+    """Client side of TcpHub; satisfies BroadcastChannel."""
+
+    def __init__(self, host: str, port: int) -> None:
+        self._addr = (host, port)
+
+    def _rpc(self, payload: bytes, read_reply) -> object:
+        with socket.create_connection(self._addr, timeout=60.0) as s:
+            s.sendall(payload)
+            f = s.makefile("rb")
+            return read_reply(f)
+
+    def publish(self, round_no: int, sender: int, payload: bytes) -> None:
+        msg = bytes([_OP_PUB]) + struct.pack("<III", round_no, sender, len(payload)) + payload
+        self._rpc(msg, lambda f: _read_exact(f, 1))
+
+    def fetch(self, round_no: int, expected: int, timeout: float = 30.0) -> dict[int, bytes]:
+        msg = bytes([_OP_FETCH]) + struct.pack(
+            "<III", round_no, expected, int(timeout * 1000)
+        )
+
+        def read_reply(f) -> dict[int, bytes]:
+            (count,) = struct.unpack("<I", _read_exact(f, 4))
+            out: dict[int, bytes] = {}
+            for _ in range(count):
+                sender, ln = struct.unpack("<II", _read_exact(f, 8))
+                out[sender] = _read_exact(f, ln)
+            return out
+
+        return self._rpc(msg, read_reply)

@@ -1,0 +1,152 @@
+"""The full 5-phase DKG protocol driven over a BroadcastChannel.
+
+This is the deployment-shaped entry point the reference leaves to the
+caller (its doctest hand-carries arrays between parties,
+src/lib.rs:60-182): each party process calls ``run_party`` with a
+channel; rounds are published/fetched as deterministic wire bytes
+(utils.serde), malformed or missing messages degrade to the protocol's
+silent-disqualification semantics (reference: committee.rs:844-853).
+
+A party that hits a protocol-fatal error still publishes its complaint
+evidence first (reference: committee.rs:340-347) and then publishes
+empty payloads for the remaining rounds so peers never block on it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..dkg.committee import (
+    DistributedKeyGeneration,
+    Environment,
+    FetchedComplaints2,
+    FetchedComplaints4,
+    FetchedPhase1,
+    FetchedPhase3,
+    FetchedPhase5,
+)
+from ..dkg.errors import DkgError
+from ..dkg.procedure_keys import (
+    MasterPublicKey,
+    MemberCommunicationKey,
+    MemberCommunicationPublicKey,
+    MemberSecretShare,
+)
+from ..utils import serde
+from .channel import BroadcastChannel
+
+
+@dataclass
+class PartyResult:
+    index: int
+    master: Optional[MasterPublicKey] = None
+    share: Optional[MemberSecretShare] = None
+    error: Optional[DkgError] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None and self.master is not None
+
+
+def _publish(channel, round_no: int, my: int, payload: Optional[bytes]) -> None:
+    channel.publish(round_no, my, payload or b"")
+
+
+def _drain(channel, my: int, start_round: int, result: PartyResult) -> PartyResult:
+    """Publish empties for the remaining rounds so peers don't block."""
+    for r in range(start_round, 6):
+        _publish(channel, r, my, b"")
+    return result
+
+
+def run_party(
+    channel: BroadcastChannel,
+    env: Environment,
+    comm_key: MemberCommunicationKey,
+    committee_pks: list[MemberCommunicationPublicKey],
+    my: int,
+    rng,
+    timeout: float = 30.0,
+) -> PartyResult:
+    """Execute one party's side of the ceremony over ``channel``.
+
+    ``my`` is the party's 1-based index in the byte-sorted committee
+    (reference: committee.rs:134-135); returns the master public key and
+    this party's secret share on success.
+    """
+    group = env.group
+    n = env.nr_members
+    others = [j for j in range(1, n + 1) if j != my]
+
+    def fetch(round_no: int) -> dict[int, bytes]:
+        return channel.fetch(round_no, n, timeout)
+
+    # ---- round 1: dealing ------------------------------------------------
+    phase1, b1 = DistributedKeyGeneration.init(env, rng, comm_key, committee_pks, my)
+    _publish(channel, 1, my, serde.encode_phase1(group, b1))
+    got1 = fetch(1)
+    fetched1 = [
+        FetchedPhase1.from_broadcast(
+            env, j, serde.decode_phase1(group, got1[j]) if got1.get(j) else None
+        )
+        for j in others
+    ]
+
+    # ---- round 2: share verification + complaints ------------------------
+    nxt, b2 = phase1.proceed(fetched1, rng)
+    _publish(channel, 2, my, serde.encode_phase2(group, b2) if b2 else None)
+    if isinstance(nxt, DkgError):
+        return _drain(channel, my, 3, PartyResult(my, error=nxt))
+    got2 = fetch(2)
+    complaints2 = [
+        FetchedComplaints2(
+            j, serde.decode_phase2(group, got2[j]) if got2.get(j) else None
+        )
+        for j in others
+    ]
+
+    # ---- round 3: qualified set + bare commitments -----------------------
+    nxt, b3 = nxt.proceed(complaints2, fetched1)
+    if isinstance(nxt, DkgError):
+        return _drain(channel, my, 3, PartyResult(my, error=nxt))
+    _publish(channel, 3, my, serde.encode_phase3(group, b3) if b3 else None)
+    got3 = fetch(3)
+    fetched3 = [
+        FetchedPhase3.from_broadcast(
+            env, j, serde.decode_phase3(group, got3[j]) if got3.get(j) else None
+        )
+        for j in others
+    ]
+
+    # ---- round 4: re-verification + disclosure complaints ----------------
+    nxt, b4 = nxt.proceed(fetched3)
+    _publish(channel, 4, my, serde.encode_phase4(group, b4) if b4 else None)
+    if isinstance(nxt, DkgError):
+        return _drain(channel, my, 5, PartyResult(my, error=nxt))
+    got4 = fetch(4)
+    complaints4 = [
+        FetchedComplaints4(
+            j, serde.decode_phase4(group, got4[j]) if got4.get(j) else None
+        )
+        for j in others
+    ]
+
+    # ---- round 5: adjudication + share disclosure ------------------------
+    nxt, b5 = nxt.proceed(complaints4)
+    _publish(channel, 5, my, serde.encode_phase5(group, b5) if b5 else None)
+    if isinstance(nxt, DkgError):
+        return PartyResult(my, error=nxt)
+    got5 = fetch(5)
+    fetched5 = [
+        FetchedPhase5(
+            j, serde.decode_phase5(group, got5[j]) if got5.get(j) else None
+        )
+        for j in others
+    ]
+
+    out, _ = nxt.finalise(fetched5)
+    if isinstance(out, DkgError):
+        return PartyResult(my, error=out)
+    master, share = out
+    return PartyResult(my, master=master, share=share)

@@ -1,4 +1,4 @@
 """Pallas TPU kernels for the hot ops (XLA-path twins live in
 fields/ and groups/; these are the hand-tiled Mosaic versions)."""
 
-from . import pallas_field  # noqa: F401
+from . import pallas_field, pallas_point  # noqa: F401

@@ -96,27 +96,69 @@ def _cond_sub(rows_x, const_limbs):
     return [jnp.where(keep, xi, di) for xi, di in zip(rows_x, diff)]
 
 
-def _make_kernel(fs: FieldSpec):
+def mod_mul_rows(fs: FieldSpec, rows_a, rows_b):
+    """Modular multiply on unrolled limb-row lists: L tiles in, L out.
+
+    The reusable core of the kernel — the fused point-op kernels
+    (ops/pallas_point.py) chain many of these without leaving VMEM.
+    Barrett (HAC 14.42), base 2**16 — mirrors fields/device.py.
+    """
     L = fs.limbs
     mu = [int(v) for v in fs.barrett_mu]  # (L+1,) Python ints
     p_ext = [int(v) for v in fs.p_limbs_ext]  # (L+1,)
+    x = _normalize(_mul_columns(rows_a, rows_b))  # 2L limb tiles
+    q1 = x[L - 1 :]  # L+1 tiles
+    mu_rows = [jnp.full_like(x[0], np.uint32(m)) for m in mu]
+    q2 = _normalize(_mul_columns(q1, mu_rows))
+    q3 = q2[L + 1 :]  # L+1 tiles
+    pe_rows = [jnp.full_like(x[0], np.uint32(m)) for m in p_ext]
+    r2 = _normalize(_mul_columns(q3, pe_rows))[: L + 1]
+    r1 = x[: L + 1]
+    r, _ = _sub_with_borrow(r1, r2)  # mod b**(L+1): r in [0, 3p)
+    r = _cond_sub(r, p_ext)
+    r = _cond_sub(r, p_ext)
+    return r[:L]
+
+
+def mod_add_rows(fs: FieldSpec, rows_a, rows_b):
+    """Modular add on limb-row lists (L tiles in, L out)."""
+    p_ext = [int(v) for v in fs.p_limbs_ext]
+    # limb sums < 2**17; one extra carry limb needed before cond_sub
+    carry = jnp.zeros_like(rows_a[0])
+    out = []
+    for a, b in zip(rows_a, rows_b):
+        t = a + b + carry
+        out.append(t & jnp.uint32(0xFFFF))
+        carry = t >> 16
+    out.append(carry)  # L+1 tiles
+    out = _cond_sub(out, p_ext)
+    return out[: fs.limbs]
+
+
+def mod_sub_rows(fs: FieldSpec, rows_a, rows_b):
+    """Modular subtract on limb-row lists: (a + p) - b, then reduce."""
+    p_limbs = [int(v) for v in fs.p_limbs]
+    p_ext = [int(v) for v in fs.p_limbs_ext]
+    carry = jnp.zeros_like(rows_a[0])
+    ap = []
+    for a, p in zip(rows_a, p_limbs):
+        t = a + jnp.uint32(p) + carry
+        ap.append(t & jnp.uint32(0xFFFF))
+        carry = t >> 16
+    ap.append(carry)  # L+1 tiles, = a + p < 2p < b**(L+1)
+    b_ext = list(rows_b) + [jnp.zeros_like(rows_b[0])]
+    d, _ = _sub_with_borrow(ap, b_ext)  # in [0, 2p)
+    d = _cond_sub(d, p_ext)
+    return d[: fs.limbs]
+
+
+def _make_kernel(fs: FieldSpec):
+    L = fs.limbs
 
     def kernel(a_ref, b_ref, out_ref):
         rows_a = [a_ref[i : i + 1, :] for i in range(L)]
         rows_b = [b_ref[i : i + 1, :] for i in range(L)]
-        x = _normalize(_mul_columns(rows_a, rows_b))  # 2L limb tiles
-
-        # Barrett (HAC 14.42), base 2**16 — mirrors fields/device.py.
-        q1 = x[L - 1 :]  # L+1 tiles
-        mu_rows = [jnp.full_like(x[0], np.uint32(m)) for m in mu]
-        q2 = _normalize(_mul_columns(q1, mu_rows))
-        q3 = q2[L + 1 :]  # L+1 tiles
-        pe_rows = [jnp.full_like(x[0], np.uint32(m)) for m in p_ext]
-        r2 = _normalize(_mul_columns(q3, pe_rows))[: L + 1]
-        r1 = x[: L + 1]
-        r, _ = _sub_with_borrow(r1, r2)  # mod b**(L+1): r in [0, 3p)
-        r = _cond_sub(r, p_ext)
-        r = _cond_sub(r, p_ext)
+        r = mod_mul_rows(fs, rows_a, rows_b)
         for i in range(L):
             out_ref[i : i + 1, :] = r[i]
 

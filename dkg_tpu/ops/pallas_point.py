@@ -1,0 +1,209 @@
+"""Fused Pallas point-operation kernels (twisted Edwards, a = -1).
+
+The scalar-mult ladder's hot loop is point add/double — each one is
+~7-9 Barrett multiplies plus adds/subs.  The XLA path materialises
+every intermediate field element in HBM between fused regions; these
+kernels keep the WHOLE point operation (and the 4-double window step)
+in VMEM: coordinates ride the sublane axis as 4L limb rows, the batch
+rides the 128-wide lane axis, and the multiplies chain through
+ops.pallas_field.mod_mul_rows without ever leaving the core.
+
+Formulas mirror groups/device.py exactly (add-2008-hwcd-3 unified add,
+dbl-2008-hwcd doubling — complete for ristretto255), which mirror the
+role of dalek's backend in the reference (reference: src/groups.rs:55-90
+delegating point arithmetic to curve25519-dalek).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..groups.device import CurveSpec
+from . import pallas_field as pfk
+from .pallas_field import BLOCK, mod_add_rows, mod_mul_rows, mod_sub_rows
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    HAVE_PALLAS = False
+
+
+def _const_rows(fs, value: int, like):
+    from ..fields.spec import int_to_limbs
+
+    return [jnp.full_like(like, np.uint32(v)) for v in int_to_limbs(value % fs.modulus, fs.limbs)]
+
+
+def _ed_add_rows(cs: CurveSpec, p_rows, q_rows):
+    """Unified extended Edwards add on 4 coordinate row-lists each."""
+    f = cs.field
+    x1, y1, z1, t1 = p_rows
+    x2, y2, z2, t2 = q_rows
+    a = mod_mul_rows(f, mod_sub_rows(f, y1, x1), mod_sub_rows(f, y2, x2))
+    b = mod_mul_rows(f, mod_add_rows(f, y1, x1), mod_add_rows(f, y2, x2))
+    d2 = _const_rows(f, cs.const, x1[0])
+    c = mod_mul_rows(f, mod_mul_rows(f, t1, d2), t2)
+    d = mod_mul_rows(f, mod_add_rows(f, z1, z1), z2)
+    e = mod_sub_rows(f, b, a)
+    ff = mod_sub_rows(f, d, c)
+    g = mod_add_rows(f, d, c)
+    h = mod_add_rows(f, b, a)
+    return (
+        mod_mul_rows(f, e, ff),
+        mod_mul_rows(f, g, h),
+        mod_mul_rows(f, ff, g),
+        mod_mul_rows(f, e, h),
+    )
+
+
+def _ed_double_rows(cs: CurveSpec, p_rows):
+    """Dedicated doubling (dbl-2008-hwcd), a = -1."""
+    f = cs.field
+    x1, y1, z1, _ = p_rows
+    a = mod_mul_rows(f, x1, x1)
+    b = mod_mul_rows(f, y1, y1)
+    zz = mod_mul_rows(f, z1, z1)
+    c = mod_add_rows(f, zz, zz)
+    zero = [jnp.zeros_like(x1[0]) for _ in range(f.limbs)]
+    d = mod_sub_rows(f, zero, a)  # a = -1 => D = -A
+    xy = mod_add_rows(f, x1, y1)
+    e = mod_sub_rows(f, mod_sub_rows(f, mod_mul_rows(f, xy, xy), a), b)
+    g = mod_add_rows(f, d, b)
+    h = mod_sub_rows(f, d, b)
+    ff = mod_sub_rows(f, g, c)
+    return (
+        mod_mul_rows(f, e, ff),
+        mod_mul_rows(f, g, h),
+        mod_mul_rows(f, ff, g),
+        mod_mul_rows(f, e, h),
+    )
+
+
+def _rows_in(ref, L: int):
+    """(4L, B) ref -> 4 coordinate row-lists of L tiles each."""
+    return tuple(
+        [ref[c * L + i : c * L + i + 1, :] for i in range(L)] for c in range(4)
+    )
+
+
+def _rows_out(ref, rows, L: int):
+    for c in range(4):
+        for i in range(L):
+            ref[c * L + i : c * L + i + 1, :] = rows[c][i]
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def _ed_add_call(cs: CurveSpec, p_t: jax.Array, q_t: jax.Array, interpret: bool):
+    L = cs.field.limbs
+
+    def kernel(p_ref, q_ref, out_ref):
+        _rows_out(out_ref, _ed_add_rows(cs, _rows_in(p_ref, L), _rows_in(q_ref, L)), L)
+
+    B = p_t.shape[-1]
+    spec = pl.BlockSpec((4 * L, BLOCK), lambda i: (0, i), memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        kernel,
+        grid=(B // BLOCK,),
+        in_specs=[spec, spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((4 * L, B), jnp.uint32),
+        interpret=interpret,
+    )(p_t, q_t)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2, 3))
+def _ed_window_call(cs: CurveSpec, acc_t: jax.Array, n_doubles: int, interpret: bool, entry_t: jax.Array):
+    """The fused ladder window step: n_doubles doublings then one add,
+    all inside one kernel launch — the HBM-traffic killer for
+    scalar_mul's scan body (groups/device.py _scalar_mul_core)."""
+    L = cs.field.limbs
+
+    def kernel(acc_ref, entry_ref, out_ref):
+        rows = _rows_in(acc_ref, L)
+        for _ in range(n_doubles):
+            rows = _ed_double_rows(cs, rows)
+        rows = _ed_add_rows(cs, rows, _rows_in(entry_ref, L))
+        _rows_out(out_ref, rows, L)
+
+    B = acc_t.shape[-1]
+    spec = pl.BlockSpec((4 * L, BLOCK), lambda i: (0, i), memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        kernel,
+        grid=(B // BLOCK,),
+        in_specs=[spec, spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((4 * L, B), jnp.uint32),
+        interpret=interpret,
+    )(acc_t, entry_t)
+
+
+def _to_tiles(cs: CurveSpec, pts: jax.Array) -> tuple[jax.Array, tuple, int]:
+    """(..., 4, L) -> ((4L, B_padded), batch_shape, n)."""
+    L = cs.field.limbs
+    batch = pts.shape[:-2]
+    n = 1
+    for d in batch:
+        n *= int(d)
+    m = max(BLOCK, ((n + BLOCK - 1) // BLOCK) * BLOCK)
+    flat = jnp.reshape(pts, (n, 4 * L))
+    if m != n:
+        # pad with the identity (0, 1, 1, 0) so padding lanes stay valid
+        ident = np.zeros((4, L), np.uint32)
+        ident[1, 0] = 1
+        ident[2, 0] = 1
+        flat = jnp.concatenate(
+            [flat, jnp.broadcast_to(jnp.asarray(ident.reshape(-1)), (m - n, 4 * L))]
+        )
+    return flat.T, batch, n
+
+
+def _from_tiles(cs: CurveSpec, t: jax.Array, batch: tuple, n: int) -> jax.Array:
+    L = cs.field.limbs
+    return jnp.reshape(t.T[:n], batch + (4, L))
+
+
+def _interp() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def ed_add(cs: CurveSpec, p: jax.Array, q: jax.Array, *, interpret: bool | None = None) -> jax.Array:
+    """Fused-kernel twin of groups.device.add for Edwards curves.
+
+    p, q: (..., 4, L) extended points (same batch shape)."""
+    if not HAVE_PALLAS:  # pragma: no cover
+        from ..groups import device as gd
+
+        return gd.add(cs, p, q)
+    p, q = jnp.broadcast_arrays(jnp.asarray(p, jnp.uint32), jnp.asarray(q, jnp.uint32))
+    p_t, batch, n = _to_tiles(cs, p)
+    q_t, _, _ = _to_tiles(cs, q)
+    out = _ed_add_call(cs, p_t, q_t, _interp() if interpret is None else interpret)
+    return _from_tiles(cs, out, batch, n)
+
+
+def ed_window_step(
+    cs: CurveSpec, acc: jax.Array, entry: jax.Array, n_doubles: int = 4, *, interpret: bool | None = None
+) -> jax.Array:
+    """acc <- 2^n_doubles * acc + entry, fused in one kernel launch."""
+    if not HAVE_PALLAS:  # pragma: no cover
+        from ..groups import device as gd
+
+        for _ in range(n_doubles):
+            acc = gd.double(cs, acc)
+        return gd.add(cs, acc, entry)
+    acc, entry = jnp.broadcast_arrays(
+        jnp.asarray(acc, jnp.uint32), jnp.asarray(entry, jnp.uint32)
+    )
+    acc_t, batch, n = _to_tiles(cs, acc)
+    entry_t, _, _ = _to_tiles(cs, entry)
+    out = _ed_window_call(
+        cs, acc_t, n_doubles, _interp() if interpret is None else interpret, entry_t
+    )
+    return _from_tiles(cs, out, batch, n)

@@ -1,3 +1,3 @@
 """Multi-chip sharding (mesh + collectives at round boundaries)."""
 
-from . import mesh  # noqa: F401
+from . import mesh, multihost  # noqa: F401

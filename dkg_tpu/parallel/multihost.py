@@ -1,0 +1,64 @@
+"""Multi-host ceremonies: one global mesh, DCN under the collectives.
+
+The reference has no multi-node story at all (SURVEY §2: no sockets,
+no MPI/NCCL); here scaling past one host is the SAME sharded program as
+parallel.mesh — ``jax.distributed.initialize`` forms the global runtime,
+``global_party_mesh`` lays every process's devices on the one party
+axis, and XLA routes ``all_gather``/``all_to_all`` over ICI within a
+host and DCN across hosts.  The external broadcast-channel boundary
+(dkg_tpu.net) stays host-side, exactly as the reference leaves it to
+the caller (src/lib.rs:91-92).
+
+Deployment shape (one process per host):
+
+    from dkg_tpu.parallel import multihost, mesh
+    multihost.init_multihost(coordinator_address="host0:1234",
+                             num_processes=4, process_id=rank)
+    m = multihost.global_party_mesh()
+    mesh.sharded_ceremony(cfg, m, ...)   # unchanged program
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .mesh import PARTY_AXIS
+
+
+def init_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    local_device_ids: list[int] | None = None,
+) -> None:
+    """Join the multi-process JAX runtime; no-op for single-process runs
+    so the same launcher works from a laptop to a pod slice."""
+    if not num_processes or num_processes <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+
+
+def global_party_mesh() -> Mesh:
+    """1-D party mesh over EVERY device in the (possibly multi-host)
+    runtime — `jax.devices()` is global after init_multihost."""
+    return Mesh(np.asarray(jax.devices()), (PARTY_AXIS,))
+
+
+def process_party_block(n_parties: int) -> tuple[int, int]:
+    """This process's contiguous party block [start, stop) under the
+    party-axis sharding (for host-side per-party work like DEM sealing
+    that must track the device sharding)."""
+    n_dev = jax.device_count()
+    per_dev = n_parties // n_dev
+    local = jax.local_devices()
+    ids = sorted(d.id for d in local)
+    start = ids[0] * per_dev
+    stop = (ids[-1] + 1) * per_dev
+    return start, stop

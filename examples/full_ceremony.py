@@ -16,6 +16,16 @@ import sys
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
+# Honour an explicit JAX_PLATFORMS=cpu at the config level: TPU plugin
+# registration (sitecustomize) can override the env var, and a dead
+# TPU tunnel would otherwise hang backend init on import.
+import os
+
+if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 from dkg_tpu.dkg import (
     DistributedKeyGeneration,
     DkgError,

@@ -1,0 +1,100 @@
+"""Channel-driven multi-party ceremonies: in-process and TCP hub.
+
+Host-only (no device kernels) — the multi-process transport analogue of
+the reference's hand-carried-arrays tests (committee.rs:1518-1656).
+"""
+
+import random
+import threading
+
+from dkg_tpu.dkg.committee import Environment
+from dkg_tpu.dkg.procedure_keys import MemberCommunicationKey, sort_committee
+from dkg_tpu.groups import host as gh
+from dkg_tpu.net import InProcessChannel, TcpHub, TcpHubChannel, run_party
+from dkg_tpu.poly.host import lagrange_interpolation
+
+RNG = random.Random(0x4E7)
+G = gh.RISTRETTO255
+
+
+def _committee(n, t):
+    env = Environment.init(G, t, n, b"net-test")
+    keys = [MemberCommunicationKey.generate(G, RNG) for _ in range(n)]
+    pks = sort_committee(G, [k.public() for k in keys])
+    by_pk = {G.encode(k.public().point): k for k in keys}
+    sorted_keys = [by_pk[G.encode(p.point)] for p in pks]
+    return env, sorted_keys, pks
+
+
+def _run_threaded(channel_for, env, keys, pks, n):
+    results = [None] * n
+    seeds = [random.Random(RNG.randrange(2**63)) for _ in range(n)]
+
+    def worker(i):
+        results[i] = run_party(
+            channel_for(i), env, keys[i], pks, i + 1, seeds[i], timeout=60.0
+        )
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=120)
+    return results
+
+
+def _assert_ceremony_ok(env, results, n, t):
+    assert all(r is not None and r.ok for r in results), [
+        (r.index, r.error) if r else None for r in results
+    ]
+    m0 = results[0].master.point
+    for r in results[1:]:
+        assert G.eq(r.master.point, m0)
+    shares = sorted((r.index, r.share.value) for r in results)[: t + 1]
+    secret = lagrange_interpolation(
+        G.scalar_field, 0, [s for _, s in shares], [i for i, _ in shares]
+    )
+    assert G.eq(m0, G.scalar_mul(secret, G.generator()))
+
+
+def test_inprocess_channel_ceremony():
+    n, t = 3, 1
+    env, keys, pks = _committee(n, t)
+    chan = InProcessChannel()
+    results = _run_threaded(lambda i: chan, env, keys, pks, n)
+    _assert_ceremony_ok(env, results, n, t)
+
+
+def test_tcp_hub_ceremony():
+    n, t = 3, 1
+    env, keys, pks = _committee(n, t)
+    hub = TcpHub().start()
+    try:
+        host, port = hub.address
+        results = _run_threaded(
+            lambda i: TcpHubChannel(host, port), env, keys, pks, n
+        )
+        _assert_ceremony_ok(env, results, n, t)
+    finally:
+        hub.stop()
+
+
+def test_dropout_party_does_not_block_others():
+    """Party 3 never shows up; survivors time out on it and finish
+    (silent-dropout disqualification, reference committee.rs:332-337)."""
+    n, t = 3, 1
+    env, keys, pks = _committee(n, t)
+    chan = InProcessChannel()
+    results = [None] * 2
+
+    def worker(i):
+        results[i] = run_party(chan, env, keys[i], pks, i + 1, random.Random(i), timeout=2.0)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=120)
+    assert all(r is not None and r.ok for r in results)
+    assert G.eq(results[0].master.point, results[1].master.point)
+    # the silent party is out of the qualified set on both survivors

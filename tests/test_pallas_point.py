@@ -1,0 +1,58 @@
+"""Fused Pallas Edwards kernels vs the XLA-path group ops.
+
+Interpret mode on CPU; the fused window step is heavyweight to compile
+in interpret mode, so it runs only with DKG_TPU_SLOW_TESTS=1 (or on a
+real TPU backend).
+"""
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+import jax
+
+from dkg_tpu.groups import device as gd
+from dkg_tpu.groups import host as gh
+from dkg_tpu.ops import pallas_point as pp
+
+RNG = random.Random(0xEDED)
+G = gh.RISTRETTO255
+CS = gd.RISTRETTO255
+
+RUN_SLOW = (
+    os.environ.get("DKG_TPU_SLOW_TESTS") == "1" or jax.default_backend() == "tpu"
+)
+
+
+def _pts(k):
+    return [G.scalar_mul(G.random_scalar(RNG), G.generator()) for _ in range(k)]
+
+
+def test_ed_add_matches_device_add():
+    ps = _pts(5) + [G.identity()]
+    qs = _pts(5) + [G.identity()]
+    p_dev = gd.from_host(CS, ps)
+    q_dev = gd.from_host(CS, qs)
+    got = pp.ed_add(CS, p_dev, q_dev)
+    want = gd.add(CS, p_dev, q_dev)
+    got_h = gd.to_host(CS, np.asarray(got))
+    want_h = gd.to_host(CS, np.asarray(want))
+    for a, b in zip(got_h, want_h):
+        assert G.eq(a, b)
+
+
+@pytest.mark.skipif(not RUN_SLOW, reason="fused window kernel: slow interpret-mode compile")
+def test_ed_window_step_matches_ladder():
+    ps = _pts(3)
+    es = _pts(3)
+    acc = gd.from_host(CS, ps)
+    ent = gd.from_host(CS, es)
+    got = pp.ed_window_step(CS, acc, ent, n_doubles=4)
+    want = acc
+    for _ in range(4):
+        want = gd.double(CS, want)
+    want = gd.add(CS, want, ent)
+    for a, b in zip(gd.to_host(CS, np.asarray(got)), gd.to_host(CS, np.asarray(want))):
+        assert G.eq(a, b)

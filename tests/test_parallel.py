@@ -53,3 +53,15 @@ def test_mesh_shapes():
         assert False, "expected ValueError"
     except ValueError:
         pass
+
+
+def test_multihost_helpers_single_process():
+    """init_multihost is a no-op single-process; the global mesh spans
+    the 8 virtual devices and reports a full party block."""
+    from dkg_tpu.parallel import multihost
+
+    multihost.init_multihost()  # no-op path
+    m = multihost.global_party_mesh()
+    assert m.devices.size == len(jax.devices())
+    start, stop = multihost.process_party_block(16)
+    assert (start, stop) == (0, 16)
