@@ -14,6 +14,21 @@ reference Rust crate `dkg`, see SURVEY.md), redesigned TPU-first:
 * participant-axis sharding over a device mesh (``dkg_tpu.parallel``).
 """
 
-from dkg_tpu import crypto, dkg, fields, groups, net, ops, parallel, poly, utils  # noqa: F401
+import importlib
 
 __version__ = "0.1.0"
+
+_SUBMODULES = ("crypto", "dkg", "fields", "groups", "native", "net", "ops",
+               "parallel", "poly", "utils")
+
+
+# Lazy submodule loading (PEP 562): importing `dkg_tpu` must stay free of
+# jax work so platform forcing (parallel/hostmesh.py) can run first.
+def __getattr__(name):
+    if name in _SUBMODULES:
+        return importlib.import_module(f"dkg_tpu.{name}")
+    raise AttributeError(f"module 'dkg_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_SUBMODULES))

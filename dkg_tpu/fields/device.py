@@ -37,7 +37,10 @@ from jax import lax
 
 from .spec import FieldSpec
 
-MASK16 = jnp.uint32(0xFFFF)
+# Plain int, not jnp.uint32: a module-level device constant would
+# initialise the jax backend at import time, defeating hostmesh's
+# platform forcing.  uint32-array ops with a Python int stay uint32.
+MASK16 = 0xFFFF
 
 # Opt-in Pallas path for the modular multiply (ops/pallas_field.py).
 # Static at import: the dispatch must not introduce traced control flow.

@@ -1,3 +1,17 @@
 """Multi-chip sharding (mesh + collectives at round boundaries)."""
 
-from . import mesh, multihost  # noqa: F401
+import importlib
+
+_SUBMODULES = ("hostmesh", "mesh", "multihost")
+
+
+# Lazy (PEP 562): `from dkg_tpu.parallel.hostmesh import force_cpu_mesh`
+# must not drag in mesh/multihost (and with them jax) first.
+def __getattr__(name):
+    if name in _SUBMODULES:
+        return importlib.import_module(f"dkg_tpu.parallel.{name}")
+    raise AttributeError(f"module 'dkg_tpu.parallel' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_SUBMODULES))

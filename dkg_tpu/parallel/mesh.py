@@ -28,9 +28,31 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 try:  # jax >= 0.8 promotes shard_map out of experimental
-    from jax import shard_map
+    from jax import shard_map as _shard_map
 except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+import inspect
+
+# jax renamed shard_map's replication-check kwarg (check_rep -> check_vma
+# in 0.9).  Resolve the right name once so call sites stay stable; fail
+# loudly on a future rename rather than silently re-enabling the check.
+_SHARD_MAP_CHECK_KW = next(
+    (k for k in ("check_vma", "check_rep") if k in inspect.signature(_shard_map).parameters),
+    None,
+)
+if _SHARD_MAP_CHECK_KW is None:  # pragma: no cover
+    raise RuntimeError(
+        "installed jax's shard_map has neither check_vma nor check_rep; "
+        "update _SHARD_MAP_CHECK_KW in dkg_tpu/parallel/mesh.py for this jax version"
+    )
+
+
+def shard_map(f, *, mesh, in_specs, out_specs):
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **{_SHARD_MAP_CHECK_KW: False},
+    )
 
 from ..dkg import ceremony as ce
 from ..fields import device as fd
@@ -42,9 +64,21 @@ PARTY_AXIS = "parties"
 
 def make_mesh(n_devices: int | None = None) -> Mesh:
     """1-D mesh over the party axis (v5e-8: 8 shards, 512 parties/shard
-    at n=4096 — SURVEY §2 table row 4)."""
+    at n=4096 — SURVEY §2 table row 4).
+
+    Raises rather than truncating when fewer than ``n_devices`` devices
+    exist (e.g. the backend initialised before hostmesh forcing took
+    effect) — a silently smaller mesh would make sharding "tests" pass
+    without exercising the collectives.
+    """
     devs = jax.devices()
     if n_devices is not None:
+        if len(devs) < n_devices:
+            raise RuntimeError(
+                f"requested a {n_devices}-device mesh but only {len(devs)} "
+                "devices exist (was the jax backend initialised before "
+                "hostmesh.force_cpu_mesh?)"
+            )
         devs = devs[:n_devices]
     return Mesh(np.asarray(devs), (PARTY_AXIS,))
 
@@ -74,7 +108,6 @@ def sharded_ceremony(
         mesh=mesh,
         in_specs=(P(PARTY_AXIS), P(PARTY_AXIS), P(), P(), P()),
         out_specs=(P(PARTY_AXIS), P(PARTY_AXIS), P()),
-        check_rep=False,
     )
     def step(ca, cb, gt, ht, rho_all):
         # --- round 1, local dealing (deal() evaluates at global indices)

@@ -2,32 +2,17 @@
 
 Multi-chip TPU hardware is not available in CI; sharding correctness is
 validated on a virtual CPU mesh exactly as the driver's dryrun does.
-Must run before jax is imported anywhere.
+Must run before any jax *backend initialisation* (hostmesh.py explains
+the ordering; test_import_hygiene.py guards it).
 """
 
-import os
+from dkg_tpu.parallel.hostmesh import force_cpu_mesh
 
-# FORCE cpu (not setdefault): the driver environment pins
-# JAX_PLATFORMS=axon (the real TPU tunnel); tests must run on the
-# 8-virtual-device CPU mesh and must not contend for the single chip.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+force_cpu_mesh(8)
 
 # persistent compile cache: the limb-arithmetic graphs are large and
 # recompiling them dominates test wall-clock otherwise
 import jax
-
-# The env var alone is NOT enough: the driver image's sitecustomize.py
-# registers the axon TPU plugin at interpreter start and sets the
-# jax_platforms *config* to "axon,cpu", which outranks JAX_PLATFORMS.
-# Without this override the first jitted op in the test process tries to
-# claim the real TPU through the tunnel and blocks indefinitely when the
-# relay is saturated/down.  Config-level update wins over both.
-jax.config.update("jax_platforms", "cpu")
 
 jax.config.update("jax_compilation_cache_dir", "/tmp/dkg_tpu_jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

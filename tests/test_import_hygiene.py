@@ -1,0 +1,50 @@
+"""Importing dkg_tpu must never initialise a jax backend.
+
+Platform forcing (parallel/hostmesh.py) only works before the first
+backend initialisation.  A module-level device constant anywhere in the
+package (e.g. ``jnp.uint32(...)`` at import scope) would initialise the
+backend during ``import dkg_tpu`` itself — in the driver environment
+that means claiming the real TPU through the tunnel before the CPU mesh
+can be forced.  Run in a subprocess so this process's already-live
+backend doesn't mask the check.
+"""
+
+import subprocess
+import sys
+
+
+def test_package_import_initialises_no_backend():
+    code = (
+        "import dkg_tpu, dkg_tpu.fields, dkg_tpu.groups, dkg_tpu.crypto, "
+        "dkg_tpu.dkg, dkg_tpu.poly, dkg_tpu.ops, dkg_tpu.parallel, "
+        "dkg_tpu.net, dkg_tpu.utils\n"
+        "import jax._src.xla_bridge as xb\n"
+        "assert not xb._backends, f'backends initialised at import: {list(xb._backends)}'\n"
+        "print('clean')\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, timeout=120
+    )
+    assert out.returncode == 0, out.stderr
+    assert "clean" in out.stdout
+
+
+def test_hostmesh_import_is_lightweight():
+    # The driver image's sitecustomize preloads jax itself, so "jax not
+    # in sys.modules" is unattainable; assert the real invariants: no
+    # backend initialised, and none of the heavy compute modules pulled.
+    code = (
+        "import sys\n"
+        "from dkg_tpu.parallel.hostmesh import force_cpu_mesh\n"
+        "heavy = [m for m in sys.modules if m.startswith('dkg_tpu.') and\n"
+        "         m.split('.')[1] in ('fields', 'groups', 'crypto', 'dkg', 'ops', 'poly')]\n"
+        "assert not heavy, f'hostmesh import dragged in {heavy}'\n"
+        "import jax._src.xla_bridge as xb\n"
+        "assert not xb._backends, 'hostmesh import initialised a backend'\n"
+        "print('clean')\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, timeout=120
+    )
+    assert out.returncode == 0, out.stderr
+    assert "clean" in out.stdout
