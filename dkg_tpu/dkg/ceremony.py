@@ -252,10 +252,90 @@ def master_key_from_bare(cfg: CeremonyConfig, a_comm: jax.Array, qualified: jax.
 # ---------------------------------------------------------------------------
 
 
+def _dealer_row_digests(shares_rows: np.ndarray, hidings_rows: np.ndarray) -> np.ndarray:
+    """Per-dealer digests of the delivered share/hiding rows.
+
+    (k, n, L) x2 -> (k, 32) uint8.  Dealer position is bound by the
+    order in which the caller folds these into the outer digest."""
+    out = np.zeros((len(shares_rows), 32), np.uint8)
+    for i in range(len(shares_rows)):
+        h = hashlib.blake2b(digest_size=32, person=b"dkgtpu-row")
+        h.update(np.ascontiguousarray(shares_rows[i]))
+        h.update(np.ascontiguousarray(hidings_rows[i]))
+        out[i] = np.frombuffer(h.digest(), np.uint8)
+    return out
+
+
+def _fold_digest(cfg: CeremonyConfig, a_np: np.ndarray, e_np: np.ndarray,
+                 row_digests: np.ndarray) -> bytes:
+    h = hashlib.blake2b(digest_size=32, person=b"dkgtpu-tr")
+    h.update(f"{cfg.curve}|{cfg.n}|{cfg.t}|".encode())
+    for arr in (a_np, e_np):
+        a = np.ascontiguousarray(arr)
+        h.update(str(a.shape).encode() + str(a.dtype).encode())
+        h.update(a)  # streamed: no bytes() copy of ~100 MB tensors
+    h.update(np.ascontiguousarray(row_digests))
+    return h.digest()
+
+
+def transcript_digest(cfg: CeremonyConfig, a_comm, e_comm, shares, hidings) -> bytes:
+    """Digest of the COMPLETE round-1 broadcast transcript.
+
+    Binds every limb of all four round-1 tensors — bare commitments A,
+    randomized commitments E, and the delivered share/hiding matrices
+    (the engine's stand-ins for the public broadcast: in the wire
+    protocol the encrypted shares are public and determine s/r,
+    reference committee.rs:163-186).  An adaptive dealer cannot change
+    any part of its round-1 output without changing the derived batch
+    randomizers.
+
+    Structure is canonical AND shard-foldable: commitments are hashed
+    flat (they are replicated after the round-1 allgather), while the
+    share matrices enter via per-dealer row digests folded in dealer
+    order — so :func:`sharded_transcript_digest` can compute the exact
+    same value from dealer-sharded arrays without materializing them on
+    any single host.
+    """
+    rows = _dealer_row_digests(np.asarray(shares), np.asarray(hidings))
+    return _fold_digest(cfg, np.asarray(a_comm), np.asarray(e_comm), rows)
+
+
+def sharded_transcript_digest(cfg: CeremonyConfig, a_all, e_all, s, r) -> bytes:
+    """transcript_digest over mesh-sharded round-1 output.
+
+    a_all/e_all are replicated (locally addressable on every process);
+    s/r are dealer-sharded.  Each process digests its local dealer rows;
+    only the 32-byte row digests cross process boundaries, so this works
+    on multi-host meshes where ``np.asarray(s)`` would fail (shards on
+    non-addressable devices).  Bit-identical to ``transcript_digest`` on
+    the unsharded arrays.
+    """
+    rows = np.zeros((cfg.n, 32), np.uint8)
+    shards_s = sorted(s.addressable_shards, key=lambda sh: sh.index[0].start or 0)
+    shards_r = sorted(r.addressable_shards, key=lambda sh: sh.index[0].start or 0)
+    seen = set()
+    for sh_s, sh_r in zip(shards_s, shards_r):
+        sl = sh_s.index[0]
+        assert sh_r.index[0] == sl, "s/r must be sharded identically"
+        if (sl.start, sl.stop) in seen:  # replicated shard copy
+            continue
+        seen.add((sl.start, sl.stop))
+        rows[sl] = _dealer_row_digests(np.asarray(sh_s.data), np.asarray(sh_r.data))
+    if jax.process_count() > 1:  # pragma: no cover — single-process CI
+        from jax.experimental import multihost_utils as mhu
+
+        gathered = np.asarray(mhu.process_allgather(jnp.asarray(rows)))
+        # each dealer row is owned by exactly one process; others are 0
+        rows = np.bitwise_or.reduce(gathered, axis=0)
+    return _fold_digest(cfg, np.asarray(a_all), np.asarray(e_all), rows)
+
+
 def fiat_shamir_rho(cfg: CeremonyConfig, transcript: bytes, rho_bits: int) -> np.ndarray:
     """Public batch-verification randomizers derived from the round-1
     transcript (publicly recomputable, so the batch check is itself
-    verifiable).  Returns (n, L) uint32 limbs with rho_bits entropy."""
+    verifiable).  ``transcript`` must be a binding digest of the full
+    round-1 broadcast — use :func:`transcript_digest`.  Returns (n, L)
+    uint32 limbs with rho_bits entropy."""
     fs = cfg.cs.scalar
     out = np.zeros((cfg.n, fs.limbs), np.uint32)
     nbytes = (rho_bits + 7) // 8
@@ -266,6 +346,23 @@ def fiat_shamir_rho(cfg: CeremonyConfig, transcript: bytes, rho_bits: int) -> np
         ).digest()
         out[j] = fh.encode(fs, int.from_bytes(h, "little"))
     return out
+
+
+def derive_rho(
+    cfg: CeremonyConfig, a_comm, e_comm, shares, hidings, rho_bits: int
+) -> np.ndarray:
+    """rho from the real round-1 transcript — the only sound way to get
+    batch randomizers (every caller path: engine, bench, sharded,
+    driver entry).
+
+    Binds ALL FOUR round-1 tensors.  The bare commitments A must be
+    bound too: they feed ``master_key_from_bare`` and (in the reference,
+    round 4) the second share check, so a dealer must not be able to
+    pick A after seeing rho any more than E/s/r.
+    """
+    return fiat_shamir_rho(
+        cfg, transcript_digest(cfg, a_comm, e_comm, shares, hidings), rho_bits
+    )
 
 
 class BatchedCeremony:
@@ -304,8 +401,7 @@ class BatchedCeremony:
                 cfg, self.coeffs_a, self.coeffs_b, self.g_table, self.h_table
             )
             _jax.block_until_ready(e)
-        transcript = np.asarray(e).tobytes()[:4096]
-        rho = jnp.asarray(fiat_shamir_rho(cfg, transcript, rho_bits))
+        rho = jnp.asarray(derive_rho(cfg, a, e, s, r, rho_bits))
         with phase_span(trace, "verify"):
             ok = verify_batch(cfg, e, s, r, rho, rho_bits, self.g_table, self.h_table)
             _jax.block_until_ready(ok)

@@ -48,7 +48,10 @@ if _SHARD_MAP_CHECK_KW is None:  # pragma: no cover
     )
 
 
-def shard_map(f, *, mesh, in_specs, out_specs):
+def _shard_map_nocheck(f, *, mesh, in_specs, out_specs):
+    """shard_map with the replication/VMA check disabled (named so a
+    future call site wanting jax's checked semantics doesn't silently
+    get this wrapper)."""
     return _shard_map(
         f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         **{_SHARD_MAP_CHECK_KW: False},
@@ -83,53 +86,117 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
     return Mesh(np.asarray(devs), (PARTY_AXIS,))
 
 
-def sharded_ceremony(
+def sharded_deal(
     cfg: ce.CeremonyConfig,
     mesh: Mesh,
     coeffs_a: jax.Array,  # (n, t+1, L) global, sharded on axis 0
     coeffs_b: jax.Array,
     g_table: jax.Array,  # replicated
     h_table: jax.Array,
-    rho: jax.Array,  # (n, L) replicated Fiat-Shamir randomizers
-    rho_bits: int,
 ):
-    """Full happy-path ceremony, parties sharded over the mesh.
+    """Round 1 over the mesh: local dealing + commitment allgather.
 
-    Returns (ok, final_shares, master): ok/final_shares sharded by
-    recipient, master replicated.  jit-compiled over the mesh; the
-    driver's ``dryrun_multichip`` runs this on a virtual CPU mesh.
+    Returns (a_all, e_all, s, r): commitments replicated (everyone has
+    fetched the broadcast), share matrices dealer-sharded — exactly the
+    public state a party holds at the end of round 1, which is what the
+    Fiat-Shamir transcript must bind before rho can exist.
     """
-    n_dev = mesh.devices.size
-    if cfg.n % n_dev != 0:
-        raise ValueError("committee size must divide evenly over the mesh")
+    _check_mesh(cfg, mesh)
 
     @functools.partial(
-        shard_map,
+        _shard_map_nocheck,
         mesh=mesh,
-        in_specs=(P(PARTY_AXIS), P(PARTY_AXIS), P(), P(), P()),
-        out_specs=(P(PARTY_AXIS), P(PARTY_AXIS), P()),
+        in_specs=(P(PARTY_AXIS), P(PARTY_AXIS), P(), P()),
+        out_specs=(P(), P(), P(PARTY_AXIS), P(PARTY_AXIS)),
     )
-    def step(ca, cb, gt, ht, rho_all):
-        # --- round 1, local dealing (deal() evaluates at global indices)
+    def step(ca, cb, gt, ht):
         a, e, s, r = ce.deal(cfg, ca, cb, gt, ht)
         # --- "broadcast + fetch" = ICI allgather of commitments
         e_all = lax.all_gather(e, PARTY_AXIS, tiled=True)  # (n, t+1, C, L)
         a_all = lax.all_gather(a, PARTY_AXIS, tiled=True)
+        return a_all, e_all, s, r
+
+    return step(coeffs_a, coeffs_b, g_table, h_table)
+
+
+def sharded_verify_finalise(
+    cfg: ce.CeremonyConfig,
+    mesh: Mesh,
+    a_all: jax.Array,  # (n, t+1, C, L) replicated bare commitments
+    e_all: jax.Array,  # (n, t+1, C, L) replicated randomized commitments
+    s: jax.Array,  # (n, n, L) dealer-sharded share matrix
+    r: jax.Array,
+    g_table: jax.Array,
+    h_table: jax.Array,
+    rho: jax.Array,  # (n, L) replicated Fiat-Shamir randomizers
+    rho_bits: int,
+):
+    """Round 2 + finalise over the mesh.
+
+    Share delivery (dealer-sharded -> recipient-sharded) rides an
+    all_to_all; each shard batch-verifies its recipient block, then
+    aggregates shares and the master key.  Returns (ok, final_shares,
+    master): ok/final_shares recipient-sharded, master replicated.
+    """
+    n_dev = _check_mesh(cfg, mesh)
+
+    @functools.partial(
+        _shard_map_nocheck,
+        mesh=mesh,
+        in_specs=(P(), P(), P(PARTY_AXIS), P(PARTY_AXIS), P(), P(), P()),
+        out_specs=(P(PARTY_AXIS), P(PARTY_AXIS), P()),
+    )
+    def step(a_g, e_g, s_sh, r_sh, gt, ht, rho_all):
         # --- share delivery: dealer-sharded -> recipient-sharded
-        s_recv = lax.all_to_all(s, PARTY_AXIS, split_axis=1, concat_axis=0, tiled=True)
-        r_recv = lax.all_to_all(r, PARTY_AXIS, split_axis=1, concat_axis=0, tiled=True)
+        s_recv = lax.all_to_all(s_sh, PARTY_AXIS, split_axis=1, concat_axis=0, tiled=True)
+        r_recv = lax.all_to_all(r_sh, PARTY_AXIS, split_axis=1, concat_axis=0, tiled=True)
         # --- round 2: RLC batch verification of the local recipient block
         shard = lax.axis_index(PARTY_AXIS)
         block = cfg.n // n_dev
         first = shard * block + 1
-        ok = _verify_block(cfg, e_all, s_recv, r_recv, rho_all, rho_bits, gt, ht, first, block)
+        ok = _verify_block(cfg, e_g, s_recv, r_recv, rho_all, rho_bits, gt, ht, first, block)
         # --- aggregation + master key (all dealers qualified: happy path)
         qualified = jnp.ones((cfg.n,), bool)
         finals = ce.aggregate_shares(cfg, s_recv, qualified)
-        master = ce.master_key_from_bare(cfg, a_all, qualified)
+        master = ce.master_key_from_bare(cfg, a_g, qualified)
         return ok, finals, master
 
-    return step(coeffs_a, coeffs_b, g_table, h_table, rho)
+    return step(a_all, e_all, s, r, g_table, h_table, rho)
+
+
+def sharded_ceremony(
+    cfg: ce.CeremonyConfig,
+    mesh: Mesh,
+    coeffs_a: jax.Array,
+    coeffs_b: jax.Array,
+    g_table: jax.Array,
+    h_table: jax.Array,
+    rho_bits: int = 128,
+):
+    """Full happy-path ceremony, parties sharded over the mesh.
+
+    Two device phases with a host Fiat-Shamir boundary between them —
+    rho is derived from the digest of the COMPLETE round-1 transcript
+    (commitments + delivered shares), never from a fixed string, so the
+    batch check is sound against an adaptive dealer and publicly
+    recomputable.  jit-compiled over the mesh; the driver's
+    ``dryrun_multichip`` runs this on a virtual CPU mesh.
+    """
+    a_all, e_all, s, r = sharded_deal(cfg, mesh, coeffs_a, coeffs_b, g_table, h_table)
+    jax.block_until_ready(e_all)
+    # multihost-safe: only 32-byte row digests cross process boundaries
+    digest = ce.sharded_transcript_digest(cfg, a_all, e_all, s, r)
+    rho = jnp.asarray(ce.fiat_shamir_rho(cfg, digest, rho_bits))
+    return sharded_verify_finalise(
+        cfg, mesh, a_all, e_all, s, r, g_table, h_table, rho, rho_bits
+    )
+
+
+def _check_mesh(cfg: ce.CeremonyConfig, mesh: Mesh) -> int:
+    n_dev = mesh.devices.size
+    if cfg.n % n_dev != 0:
+        raise ValueError("committee size must divide evenly over the mesh")
+    return n_dev
 
 
 def _verify_block(cfg, e_all, s_recv, r_recv, rho, rho_bits, g_table, h_table, first, block):

@@ -91,7 +91,11 @@ def test_batch_verify_all_pass_and_detects_cheat(ceremony):
     fs = cfg.cs.scalar
     bad = np.asarray(out["shares"]).copy()
     bad[1, 0] = fh.encode(fs, (fh.decode_int(fs, bad[1, 0]) + 5) % fs.modulus)
-    rho = jnp.asarray(ce.fiat_shamir_rho(cfg, b"transcript", 64))
+    rho = jnp.asarray(
+        ce.derive_rho(
+            cfg, out["bare"], out["randomized"], out["shares"], out["hidings"], 64
+        )
+    )
     ok = np.asarray(
         ce.verify_batch(
             cfg, out["randomized"], jnp.asarray(bad), out["hidings"], rho, 64,
@@ -100,6 +104,41 @@ def test_batch_verify_all_pass_and_detects_cheat(ceremony):
     )
     assert not ok[0]  # recipient 0's batch check fails
     assert ok[1:].all()
+
+
+def test_fiat_shamir_binds_entire_transcript(ceremony):
+    """rho must change when ANY limb of ANY dealer's round-1 output
+    flips — the round-1 transcript digest covers every tensor in full,
+    closing the adaptive-dealer hole of a truncated transcript."""
+    c, out = ceremony
+    cfg = c.cfg
+    a = np.asarray(out["bare"])
+    e = np.asarray(out["randomized"])
+    s = np.asarray(out["shares"])
+    r = np.asarray(out["hidings"])
+    rho0 = ce.derive_rho(cfg, a, e, s, r, 64)
+
+    # flip one limb of the LAST dealer's LAST commitment coefficient —
+    # far beyond any truncation window
+    e_bad = e.copy()
+    e_bad[-1, -1, -1, -1] ^= 1
+    assert not np.array_equal(ce.derive_rho(cfg, a, e_bad, s, r, 64), rho0)
+
+    # the bare commitments feed the master key, so they are bound too
+    a_bad = a.copy()
+    a_bad[-1, 0, -1, -1] ^= 1
+    assert not np.array_equal(ce.derive_rho(cfg, a_bad, e, s, r, 64), rho0)
+
+    # and the last dealer's last delivered share / hiding
+    s_bad = s.copy()
+    s_bad[-1, -1, -1] ^= 1
+    assert not np.array_equal(ce.derive_rho(cfg, a, e, s_bad, r, 64), rho0)
+    r_bad = r.copy()
+    r_bad[-1, -1, -1] ^= 1
+    assert not np.array_equal(ce.derive_rho(cfg, a, e, s, r_bad, 64), rho0)
+
+    # unchanged transcript -> identical rho (publicly recomputable)
+    assert np.array_equal(ce.derive_rho(cfg, a, e, s, r, 64), rho0)
 
 
 def test_aggregate_and_master_consistency(ceremony):

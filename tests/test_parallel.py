@@ -20,17 +20,18 @@ def test_sharded_matches_single_device():
     n, t = 8, 3
     c = ce.BatchedCeremony("ristretto255", n, t, b"sharded-test", RNG)
     rho_bits = 64
-    rho = jnp.asarray(ce.fiat_shamir_rho(c.cfg, b"tr", rho_bits))
 
-    # single-device reference
+    # single-device reference (rho from the same real-transcript digest
+    # the sharded path derives internally)
     a, e, s, r = ce.deal(c.cfg, c.coeffs_a, c.coeffs_b, c.g_table, c.h_table)
+    rho = jnp.asarray(ce.derive_rho(c.cfg, a, e, s, r, rho_bits))
     ok_ref = ce.verify_batch(c.cfg, e, s, r, rho, rho_bits, c.g_table, c.h_table)
     finals_ref = ce.aggregate_shares(c.cfg, s, jnp.ones((n,), bool))
     master_ref = ce.master_key_from_bare(c.cfg, a, jnp.ones((n,), bool))
 
     mesh = pm.make_mesh(8)
     ok, finals, master = pm.sharded_ceremony(
-        c.cfg, mesh, c.coeffs_a, c.coeffs_b, c.g_table, c.h_table, rho, rho_bits
+        c.cfg, mesh, c.coeffs_a, c.coeffs_b, c.g_table, c.h_table, rho_bits=rho_bits
     )
 
     assert np.asarray(ok).all()
@@ -40,15 +41,33 @@ def test_sharded_matches_single_device():
     np.testing.assert_array_equal(np.asarray(master), np.asarray(master_ref))
 
 
+def test_sharded_deal_matches_single_device_transcript():
+    """The sharded round-1 transcript (gathered commitments + share
+    matrices) is bit-identical to the single-device one, so both derive
+    the same Fiat-Shamir randomizers."""
+    n, t = 8, 3
+    c = ce.BatchedCeremony("ristretto255", n, t, b"sharded-tr", RNG)
+    a, e, s, r = ce.deal(c.cfg, c.coeffs_a, c.coeffs_b, c.g_table, c.h_table)
+    mesh = pm.make_mesh(8)
+    a_all, e_all, s_sh, r_sh = pm.sharded_deal(
+        c.cfg, mesh, c.coeffs_a, c.coeffs_b, c.g_table, c.h_table
+    )
+    np.testing.assert_array_equal(np.asarray(e_all), np.asarray(e))
+    np.testing.assert_array_equal(np.asarray(s_sh), np.asarray(s))
+    # the shard-folded digest equals the flat one bit-for-bit
+    assert ce.sharded_transcript_digest(
+        c.cfg, a_all, e_all, s_sh, r_sh
+    ) == ce.transcript_digest(c.cfg, a, e, s, r)
+
+
 def test_mesh_shapes():
     mesh = pm.make_mesh(8)
     assert mesh.devices.size == 8
     # committee size must divide over the mesh
     c = ce.BatchedCeremony("ristretto255", 6, 2, b"x", RNG)
-    rho = jnp.asarray(ce.fiat_shamir_rho(c.cfg, b"t", 64))
     try:
         pm.sharded_ceremony(
-            c.cfg, mesh, c.coeffs_a, c.coeffs_b, c.g_table, c.h_table, rho, 64
+            c.cfg, mesh, c.coeffs_a, c.coeffs_b, c.g_table, c.h_table, rho_bits=64
         )
         assert False, "expected ValueError"
     except ValueError:
