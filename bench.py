@@ -17,7 +17,6 @@ value/209715 > 1 means the verification round is on budget.
 from __future__ import annotations
 
 import json
-import os
 import random
 import sys
 import time
@@ -28,12 +27,34 @@ import jax.numpy as jnp
 NORTH_STAR_RATE_PER_CHIP = 4096 * 4096 / 10.0 / 8.0
 
 
+def _pallas_active() -> bool:
+    from dkg_tpu.groups import device as gd
+
+    return bool(gd.fused_kernels_active())
+
+
+def sync(tree) -> None:
+    """Force execution to completion via a tiny host readback.
+
+    On tunneled platforms (axon) ``jax.block_until_ready`` can return
+    before the dispatched computation has run; a host transfer of one
+    element is the only reliable barrier.  Executions queue in order,
+    so syncing one leaf drains everything dispatched before it.
+    """
+    import numpy as np
+
+    leaves = [x for x in jax.tree_util.tree_leaves(tree) if hasattr(x, "ndim")]
+    if leaves:
+        leaf = leaves[0]
+        np.asarray(leaf[(0,) * leaf.ndim] if leaf.ndim else leaf)
+
+
 def timed(fn, *args):
     out = fn(*args)
-    jax.block_until_ready(out)
+    sync(out)  # drain compile + any queued work
     t0 = time.perf_counter()
     out = fn(*args)
-    jax.block_until_ready(out)
+    sync(out)
     return out, time.perf_counter() - t0
 
 
@@ -94,7 +115,7 @@ def main():
                             "deal_s": round(t_deal, 3),
                             "verify_s": round(t_verify, 3),
                             "fiat_shamir_s": round(t_rho, 3),
-                            "pallas": os.environ.get("DKG_TPU_PALLAS") == "1",
+                            "pallas": _pallas_active(),
                         },
                     }
                 )

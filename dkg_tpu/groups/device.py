@@ -28,7 +28,6 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -42,9 +41,9 @@ from . import host as gh
 
 WINDOW = 4  # window bits for scalar decomposition (16-entry tables)
 
-# Opt-in fused Pallas point kernels (see ops/pallas_point.py); static at
-# import so the scan bodies trace to a fixed program.
-_USE_PALLAS = os.environ.get("DKG_TPU_PALLAS") == "1"
+# Shared lazy dispatch switch: default ON on a real TPU backend,
+# DKG_TPU_PALLAS=1/0 forces either way (see fields/device.py).
+fused_kernels_active = fd.fused_kernels_active
 
 
 def _jit_static0(fn):
@@ -145,15 +144,31 @@ def to_host(cs: CurveSpec, pts: jax.Array) -> list:
 # ---------------------------------------------------------------------------
 
 
-@_jit_static0
 def add(cs: CurveSpec, p: jax.Array, q: jax.Array) -> jax.Array:
+    if fused_kernels_active():
+        from ..ops import pallas_point
+
+        return pallas_point.pt_add(cs, p, q)
+    return _add_xla(cs, p, q)
+
+
+@_jit_static0
+def _add_xla(cs: CurveSpec, p: jax.Array, q: jax.Array) -> jax.Array:
     if cs.kind == "edwards":
         return _ed_add(cs, p, q)
     return _ws_add(cs, p, q)
 
 
-@_jit_static0
 def double(cs: CurveSpec, p: jax.Array) -> jax.Array:
+    if fused_kernels_active():
+        from ..ops import pallas_point
+
+        return pallas_point.pt_double(cs, p)
+    return _double_xla(cs, p)
+
+
+@_jit_static0
+def _double_xla(cs: CurveSpec, p: jax.Array) -> jax.Array:
     if cs.kind == "edwards":
         return _ed_double(cs, p)
     return _ws_double(cs, p)
@@ -322,11 +337,23 @@ def _n_windows(cs: CurveSpec, window: int = WINDOW) -> int:
 
 
 def _build_table(cs: CurveSpec, p: jax.Array) -> jax.Array:
-    """Per-lane window table [0P, 1P, ..., 15P]: (..., 16, C, L)."""
-    rows = [identity(cs, p.shape[:-2]), p]
-    for _ in range(14):
-        rows.append(add(cs, rows[-1], p))
-    return jnp.stack(rows, axis=-3)
+    """Per-lane window table [0P, 1P, ..., 15P]: (..., 16, C, L).
+
+    Built with a scan (one traced add body, not 14 inlined copies) to
+    keep the compile surface small — this sits inside every scalar-mul
+    / MSM / point-RLC jit.
+    """
+
+    def step(prev, _):
+        nxt = add(cs, prev, p)
+        return nxt, nxt
+
+    _, rest = lax.scan(step, p, None, length=14)  # (14, ..., C, L)
+    rest = jnp.moveaxis(rest, 0, -3)
+    ident = identity(cs, p.shape[:-2])
+    return jnp.concatenate(
+        [ident[..., None, :, :], p[..., None, :, :], rest], axis=-3
+    )
 
 
 def _gather_table(table: jax.Array, digit: jax.Array) -> jax.Array:
@@ -382,24 +409,24 @@ def _scalar_mul_core(cs: CurveSpec, k: jax.Array, p: jax.Array) -> jax.Array:
     complete formulas).  Replaces the reference's per-point dalek scalar
     mult (reference: src/groups.rs:70-76) with one wide batched op.
 
-    With DKG_TPU_PALLAS=1 on an Edwards curve, the scan body's
+    When the fused kernels are active (default on TPU), the scan body's
     4-double+add window collapses into ONE fused Pallas kernel launch
-    (ops.pallas_point.ed_window_step) — intermediates never touch HBM.
+    (ops.pallas_point.pt_window_step) — intermediates never touch HBM.
     """
     table = _build_table(cs, p)
     digits = scalar_windows(cs, k)  # (..., NW)
     digits_rev = jnp.moveaxis(digits, -1, 0)[::-1]  # MSB first
-    fused = _USE_PALLAS and cs.kind == "edwards"
+    fused = fused_kernels_active()
     if fused:
         from ..ops import pallas_point
 
     def step(acc, dig):
         entry = _gather_table(table, dig)
         if fused:
-            return pallas_point.ed_window_step(cs, acc, entry, WINDOW), None
+            return pallas_point.pt_window_step(cs, acc, entry, WINDOW), None
         for _ in range(WINDOW):
-            acc = double(cs, acc)
-        return add(cs, acc, entry), None
+            acc = _double_xla(cs, acc)
+        return _add_xla(cs, acc, entry), None
 
     init = identity(cs, p.shape[:-2])
     acc, _ = lax.scan(step, init, digits_rev)
@@ -522,14 +549,23 @@ def scalar_mul_small(cs: CurveSpec, k: jax.Array, p: jax.Array, nbits: int) -> j
     p (..., C, L) -> (..., C, L).
 
     Branchless binary ladder, ~2·nbits point-ops — used where scalars are
-    party indices (<= n, so ~14 bits), not full field elements.
+    party indices (<= n, so ~14 bits), not full field elements.  With
+    the fused kernels active the whole ladder is ONE Pallas launch.
     """
+    if fused_kernels_active():
+        from ..ops import pallas_point
+
+        batch = jnp.broadcast_shapes(jnp.shape(k), p.shape[:-2])
+        p = jnp.broadcast_to(p, batch + p.shape[-2:])
+        return pallas_point.pt_ladder_mul_add(
+            cs, p, identity(cs, batch), k, nbits
+        )
     bits = (k.astype(jnp.uint32)[..., None] >> jnp.arange(nbits, dtype=jnp.uint32)) & 1
     bits_rev = jnp.moveaxis(bits, -1, 0)[::-1]  # (nbits, ...) MSB first
 
     def step(acc, bit):
-        acc = double(cs, acc)
-        return select(bit != 0, add(cs, acc, p), acc), None
+        acc = _double_xla(cs, acc)
+        return select(bit != 0, _add_xla(cs, acc, p), acc), None
 
     init = identity(cs, p.shape[:-2])
     acc, _ = lax.scan(step, init, bits_rev)
@@ -548,9 +584,21 @@ def eval_point_poly(
     MSM: for x = party index (<= n), each Horner step costs one
     ~nbits-bit ladder instead of a full-width scalar mult.  This is the
     TPU-native restructuring of the reference's per-pair Pippenger MSM
-    (SURVEY §2 table row 3).
+    (SURVEY §2 table row 3).  With the fused kernels active each Horner
+    step (the full ladder + add) is ONE Pallas launch.
     """
     cs_rev = jnp.moveaxis(coeffs, -3, 0)[::-1]  # (T, ..., C, L) high first
+    batch = jnp.broadcast_shapes(coeffs.shape[:-3], x.shape)
+    if fused_kernels_active():
+        from ..ops import pallas_point
+
+        def step_fused(acc, c_l):
+            return pallas_point.pt_ladder_mul_add(cs, acc, c_l, x, nbits), None
+
+        init = identity(cs, batch)
+        acc, _ = lax.scan(step_fused, init, cs_rev)
+        return acc
+
     bits = (x.astype(jnp.uint32)[..., None] >> jnp.arange(nbits, dtype=jnp.uint32)) & 1
     bits_rev = jnp.moveaxis(bits, -1, 0)[::-1]  # (nbits, ...) MSB first
 
@@ -559,13 +607,12 @@ def eval_point_poly(
         mul_acc = identity(cs, acc.shape[:-2])
 
         def ladder(m, bit):
-            m = double(cs, m)
-            return select(bit != 0, add(cs, m, acc), m), None
+            m = _double_xla(cs, m)
+            return select(bit != 0, _add_xla(cs, m, acc), m), None
 
         mul_acc, _ = lax.scan(ladder, mul_acc, bits_rev)
-        return add(cs, mul_acc, c_l), None
+        return _add_xla(cs, mul_acc, c_l), None
 
-    batch = jnp.broadcast_shapes(coeffs.shape[:-3], x.shape)
     init = identity(cs, batch)
     acc, _ = lax.scan(step, init, cs_rev)
     return acc
@@ -605,13 +652,18 @@ def msm(cs: CurveSpec, scalars: jax.Array, points: jax.Array) -> jax.Array:
     tables = _build_table(cs, points)  # (..., m, 16, C, L)
     digits = scalar_windows(cs, scalars)  # (..., m, NW)
     digits_rev = jnp.moveaxis(digits, -1, 0)[::-1]  # (NW, ..., m)
+    fused = fused_kernels_active()
+    if fused:
+        from ..ops import pallas_point
 
     def step(acc, dig):
         contribs = _gather_table(tables, dig)  # (..., m, C, L)
         total = _tree_reduce(cs, contribs, m)
+        if fused:
+            return pallas_point.pt_window_step(cs, acc, total, WINDOW), None
         for _ in range(WINDOW):
-            acc = double(cs, acc)
-        return add(cs, acc, total), None
+            acc = _double_xla(cs, acc)
+        return _add_xla(cs, acc, total), None
 
     init = identity(cs, points.shape[:-3])
     acc, _ = lax.scan(step, init, digits_rev)

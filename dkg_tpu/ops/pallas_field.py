@@ -182,9 +182,40 @@ def _mod_mul_tiles(fs: FieldSpec, a_t: jax.Array, b_t: jax.Array, interpret: boo
     )(a_t, b_t)
 
 
+def _make_madd_kernel(fs: FieldSpec):
+    L = fs.limbs
+
+    def kernel(a_ref, b_ref, c_ref, out_ref):
+        rows_a = [a_ref[i : i + 1, :] for i in range(L)]
+        rows_b = [b_ref[i : i + 1, :] for i in range(L)]
+        rows_c = [c_ref[i : i + 1, :] for i in range(L)]
+        r = mod_add_rows(fs, mod_mul_rows(fs, rows_a, rows_b), rows_c)
+        for i in range(L):
+            out_ref[i : i + 1, :] = r[i]
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnums=(0, 4))
+def _mod_madd_tiles(fs: FieldSpec, a_t, b_t, c_t, interpret: bool):
+    """(L, B) x3 -> (L, B): (a*b + c) mod p, one fused launch."""
+    L, B = a_t.shape
+    spec = pl.BlockSpec((L, BLOCK), lambda i: (0, i), memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _make_madd_kernel(fs),
+        grid=(B // BLOCK,),
+        in_specs=[spec, spec, spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((L, B), jnp.uint32),
+        interpret=interpret,
+    )(a_t, b_t, c_t)
+
+
 def _want_interpret() -> bool:
     """Mosaic only exists on real TPU backends; interpret elsewhere."""
-    return jax.default_backend() != "tpu"
+    from ..fields import device as fd
+
+    return not fd._on_tpu()
 
 
 def mod_mul(fs: FieldSpec, a: jax.Array, b: jax.Array, *, interpret: bool | None = None) -> jax.Array:
@@ -214,4 +245,39 @@ def mod_mul(fs: FieldSpec, a: jax.Array, b: jax.Array, *, interpret: bool | None
         bf = jnp.pad(bf, pad)
     interp = _want_interpret() if interpret is None else interpret
     out_t = _mod_mul_tiles(fs, af.T, bf.T, interp)
+    return jnp.reshape(out_t.T[:n], batch + (fs.limbs,))
+
+
+def mod_madd(
+    fs: FieldSpec,
+    a: jax.Array,
+    b: jax.Array,
+    c: jax.Array,
+    *,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Batched (a * b + c) mod p in ONE fused kernel launch.
+
+    The Horner-step primitive (acc <- acc·x + coeff) behind
+    poly.device.eval_many — the reference's per-recipient evaluation
+    loop (reference: src/dkg/committee.rs:163-186 ->
+    src/polynomial.rs:68-74) collapsed to one launch per coefficient.
+    """
+    if not HAVE_PALLAS:  # pragma: no cover
+        from ..fields import device as fd
+
+        return fd.add(fs, fd.mul(fs, a, b), c)
+    a, b, c = jnp.broadcast_arrays(
+        jnp.asarray(a, jnp.uint32), jnp.asarray(b, jnp.uint32), jnp.asarray(c, jnp.uint32)
+    )
+    batch = a.shape[:-1]
+    n = 1
+    for d in batch:
+        n *= int(d)
+    m = max(BLOCK, ((n + BLOCK - 1) // BLOCK) * BLOCK)
+    flat = [jnp.reshape(x, (n, fs.limbs)) for x in (a, b, c)]
+    if m != n:
+        flat = [jnp.pad(x, [(0, m - n), (0, 0)]) for x in flat]
+    interp = _want_interpret() if interpret is None else interpret
+    out_t = _mod_madd_tiles(fs, flat[0].T, flat[1].T, flat[2].T, interp)
     return jnp.reshape(out_t.T[:n], batch + (fs.limbs,))

@@ -1,17 +1,21 @@
-"""Fused Pallas point-operation kernels (twisted Edwards, a = -1).
+"""Fused Pallas point-operation kernels (Edwards AND Weierstrass a=0).
 
 The scalar-mult ladder's hot loop is point add/double — each one is
-~7-9 Barrett multiplies plus adds/subs.  The XLA path materialises
+~7-14 Barrett multiplies plus adds/subs.  The XLA path materialises
 every intermediate field element in HBM between fused regions; these
-kernels keep the WHOLE point operation (and the 4-double window step)
-in VMEM: coordinates ride the sublane axis as 4L limb rows, the batch
-rides the 128-wide lane axis, and the multiplies chain through
+kernels keep the WHOLE point operation (and multi-op sequences: the
+n-double window step, the full small-scalar ladder) in VMEM:
+coordinates ride the sublane axis as C·L limb rows, the batch rides
+the 128-wide lane axis, and the multiplies chain through
 ops.pallas_field.mod_mul_rows without ever leaving the core.
 
-Formulas mirror groups/device.py exactly (add-2008-hwcd-3 unified add,
-dbl-2008-hwcd doubling — complete for ristretto255), which mirror the
-role of dalek's backend in the reference (reference: src/groups.rs:55-90
-delegating point arithmetic to curve25519-dalek).
+Curve coverage matches groups/device.py: twisted Edwards a=-1
+(add-2008-hwcd-3 unified add, dbl-2008-hwcd doubling — complete for
+ristretto255) and short Weierstrass a=0 (Renes-Costello-Batina 2015
+algorithms 7 & 9 complete formulas — secp256k1, BLS12-381 G1).  These
+mirror the role of dalek's backend in the reference (reference:
+src/groups.rs:55-90 delegating point arithmetic to curve25519-dalek;
+MSM seam src/traits.rs:234-237).
 """
 
 from __future__ import annotations
@@ -86,124 +90,375 @@ def _ed_double_rows(cs: CurveSpec, p_rows):
     )
 
 
-def _rows_in(ref, L: int):
-    """(4L, B) ref -> 4 coordinate row-lists of L tiles each."""
+def _ws_add_rows(cs: CurveSpec, p_rows, q_rows):
+    """Complete projective add for y^2 = x^3 + b (RCB15 algorithm 7),
+    the row-list twin of groups/device.py _ws_add."""
+    f = cs.field
+    x1, y1, z1 = p_rows
+    x2, y2, z2 = q_rows
+    b3 = _const_rows(f, cs.const, x1[0])
+    t0 = mod_mul_rows(f, x1, x2)
+    t1 = mod_mul_rows(f, y1, y2)
+    t2 = mod_mul_rows(f, z1, z2)
+    t3 = mod_mul_rows(f, mod_add_rows(f, x1, y1), mod_add_rows(f, x2, y2))
+    t3 = mod_sub_rows(f, mod_sub_rows(f, t3, t0), t1)
+    t4 = mod_mul_rows(f, mod_add_rows(f, y1, z1), mod_add_rows(f, y2, z2))
+    t4 = mod_sub_rows(f, mod_sub_rows(f, t4, t1), t2)
+    xz = mod_mul_rows(f, mod_add_rows(f, x1, z1), mod_add_rows(f, x2, z2))
+    y3 = mod_sub_rows(f, mod_sub_rows(f, xz, t0), t2)
+    x3 = mod_add_rows(f, mod_add_rows(f, t0, t0), t0)
+    t2 = mod_mul_rows(f, b3, t2)
+    z3 = mod_add_rows(f, t1, t2)
+    t1 = mod_sub_rows(f, t1, t2)
+    y3 = mod_mul_rows(f, b3, y3)
+    x_out = mod_sub_rows(f, mod_mul_rows(f, t3, t1), mod_mul_rows(f, t4, y3))
+    y_out = mod_add_rows(f, mod_mul_rows(f, t1, z3), mod_mul_rows(f, x3, y3))
+    z_out = mod_add_rows(f, mod_mul_rows(f, z3, t4), mod_mul_rows(f, x3, t3))
+    return (x_out, y_out, z_out)
+
+
+def _ws_double_rows(cs: CurveSpec, p_rows):
+    """Complete doubling for y^2 = x^3 + b (RCB15 algorithm 9)."""
+    f = cs.field
+    x, y, z = p_rows
+    b3 = _const_rows(f, cs.const, x[0])
+    t0 = mod_mul_rows(f, y, y)
+    z3 = mod_add_rows(f, t0, t0)
+    z3 = mod_add_rows(f, z3, z3)
+    z3 = mod_add_rows(f, z3, z3)
+    t1 = mod_mul_rows(f, y, z)
+    t2 = mod_mul_rows(f, b3, mod_mul_rows(f, z, z))
+    x3 = mod_mul_rows(f, t2, z3)
+    y3 = mod_add_rows(f, t0, t2)
+    z3 = mod_mul_rows(f, t1, z3)
+    t1 = mod_add_rows(f, t2, t2)
+    t2 = mod_add_rows(f, t1, t2)
+    t0 = mod_sub_rows(f, t0, t2)
+    y3 = mod_add_rows(f, x3, mod_mul_rows(f, t0, y3))
+    x3 = mod_mul_rows(f, t0, mod_mul_rows(f, x, y))
+    x3 = mod_add_rows(f, x3, x3)
+    return (x3, y3, z3)
+
+
+def _add_rows(cs: CurveSpec, p_rows, q_rows):
+    if cs.kind == "edwards":
+        return _ed_add_rows(cs, p_rows, q_rows)
+    return _ws_add_rows(cs, p_rows, q_rows)
+
+
+def _double_rows(cs: CurveSpec, p_rows):
+    if cs.kind == "edwards":
+        return _ed_double_rows(cs, p_rows)
+    return _ws_double_rows(cs, p_rows)
+
+
+def _identity_rows(cs: CurveSpec, like):
+    """Constant identity point as coordinate row-lists."""
+    f = cs.field
+    zero = [jnp.zeros_like(like) for _ in range(f.limbs)]
+    one = [jnp.full_like(like, np.uint32(1))] + [
+        jnp.zeros_like(like) for _ in range(f.limbs - 1)
+    ]
+    if cs.kind == "edwards":  # (0, 1, 1, 0)
+        return (zero, one, list(one), list(zero))
+    return (zero, one, list(zero))  # (0, 1, 0)
+
+
+def _select_rows(bit, a_rows, b_rows):
+    """Per-lane select between two point row-lists; bit a (1, B) tile."""
+    keep = bit != 0
     return tuple(
-        [ref[c * L + i : c * L + i + 1, :] for i in range(L)] for c in range(4)
+        [jnp.where(keep, ai, bi) for ai, bi in zip(ac, bc)]
+        for ac, bc in zip(a_rows, b_rows)
+    )
+
+
+def _rows_in(ref, L: int, ncoords: int = 4):
+    """(C·L, B) ref -> C coordinate row-lists of L tiles each."""
+    return tuple(
+        [ref[c * L + i : c * L + i + 1, :] for i in range(L)]
+        for c in range(ncoords)
     )
 
 
 def _rows_out(ref, rows, L: int):
-    for c in range(4):
+    for c in range(len(rows)):
         for i in range(L):
             ref[c * L + i : c * L + i + 1, :] = rows[c][i]
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3))
-def _ed_add_call(cs: CurveSpec, p_t: jax.Array, q_t: jax.Array, interpret: bool):
+def _point_spec(cs: CurveSpec):
     L = cs.field.limbs
+    return pl.BlockSpec(
+        (cs.ncoords * L, BLOCK), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def _add_call(cs: CurveSpec, p_t: jax.Array, q_t: jax.Array, interpret: bool):
+    L, C = cs.field.limbs, cs.ncoords
 
     def kernel(p_ref, q_ref, out_ref):
-        _rows_out(out_ref, _ed_add_rows(cs, _rows_in(p_ref, L), _rows_in(q_ref, L)), L)
+        _rows_out(
+            out_ref, _add_rows(cs, _rows_in(p_ref, L, C), _rows_in(q_ref, L, C)), L
+        )
 
     B = p_t.shape[-1]
-    spec = pl.BlockSpec((4 * L, BLOCK), lambda i: (0, i), memory_space=pltpu.VMEM)
+    spec = _point_spec(cs)
     return pl.pallas_call(
         kernel,
         grid=(B // BLOCK,),
         in_specs=[spec, spec],
         out_specs=spec,
-        out_shape=jax.ShapeDtypeStruct((4 * L, B), jnp.uint32),
+        out_shape=jax.ShapeDtypeStruct((C * L, B), jnp.uint32),
         interpret=interpret,
     )(p_t, q_t)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 2, 3))
-def _ed_window_call(cs: CurveSpec, acc_t: jax.Array, n_doubles: int, interpret: bool, entry_t: jax.Array):
+def _double_call(cs: CurveSpec, p_t: jax.Array, n_doubles: int, interpret: bool):
+    L, C = cs.field.limbs, cs.ncoords
+
+    def kernel(p_ref, out_ref):
+        rows = _rows_in(p_ref, L, C)
+        for _ in range(n_doubles):
+            rows = _double_rows(cs, rows)
+        _rows_out(out_ref, rows, L)
+
+    B = p_t.shape[-1]
+    spec = _point_spec(cs)
+    return pl.pallas_call(
+        kernel,
+        grid=(B // BLOCK,),
+        in_specs=[spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((C * L, B), jnp.uint32),
+        interpret=interpret,
+    )(p_t)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2, 3))
+def _window_call(cs: CurveSpec, acc_t: jax.Array, n_doubles: int, interpret: bool, entry_t: jax.Array):
     """The fused ladder window step: n_doubles doublings then one add,
     all inside one kernel launch — the HBM-traffic killer for
     scalar_mul's scan body (groups/device.py _scalar_mul_core)."""
-    L = cs.field.limbs
+    L, C = cs.field.limbs, cs.ncoords
 
     def kernel(acc_ref, entry_ref, out_ref):
-        rows = _rows_in(acc_ref, L)
+        rows = _rows_in(acc_ref, L, C)
         for _ in range(n_doubles):
-            rows = _ed_double_rows(cs, rows)
-        rows = _ed_add_rows(cs, rows, _rows_in(entry_ref, L))
+            rows = _double_rows(cs, rows)
+        rows = _add_rows(cs, rows, _rows_in(entry_ref, L, C))
         _rows_out(out_ref, rows, L)
 
     B = acc_t.shape[-1]
-    spec = pl.BlockSpec((4 * L, BLOCK), lambda i: (0, i), memory_space=pltpu.VMEM)
+    spec = _point_spec(cs)
     return pl.pallas_call(
         kernel,
         grid=(B // BLOCK,),
         in_specs=[spec, spec],
         out_specs=spec,
-        out_shape=jax.ShapeDtypeStruct((4 * L, B), jnp.uint32),
+        out_shape=jax.ShapeDtypeStruct((C * L, B), jnp.uint32),
         interpret=interpret,
     )(acc_t, entry_t)
 
 
+@functools.partial(jax.jit, static_argnums=(0, 3, 4))
+def _ladder_call(
+    cs: CurveSpec,
+    p_t: jax.Array,
+    add_t: jax.Array,
+    nbits: int,
+    interpret: bool,
+    bits_t: jax.Array,
+):
+    """out = x·P + A in ONE launch, x given per-lane as MSB-first bits.
+
+    The whole double-and-select-add ladder (the Horner step of
+    eval_point_poly, reference committee.rs:292-296's sum x^l E_l) runs
+    VMEM-resident; the loop body is traced once via fori_loop so kernel
+    code size stays ~2 point-ops regardless of nbits.
+    """
+    L, C = cs.field.limbs, cs.ncoords
+
+    def kernel(p_ref, add_ref, bits_ref, out_ref):
+        p_rows = _rows_in(p_ref, L, C)
+
+        def body(i, m_arr):
+            rows = _rows_in(m_arr, L, C)
+            rows = _double_rows(cs, rows)
+            added = _add_rows(cs, rows, p_rows)
+            bit = (
+                bits_ref[i : i + 1, :]
+                if isinstance(i, int)
+                else bits_ref[pl.dslice(i, 1), :]
+            )
+            rows = _select_rows(bit, added, rows)
+            return jnp.concatenate([r for coord in rows for r in coord], axis=0)
+
+        m_arr = jnp.concatenate(
+            [r for coord in _identity_rows(cs, p_ref[0:1, :]) for r in coord], axis=0
+        )
+        if interpret:
+            # interpret-mode lowering of fori_loop over this body is
+            # pathologically slow to compile; tests use tiny nbits, so
+            # unroll instead.
+            for i in range(nbits):
+                m_arr = body(i, m_arr)
+        else:
+            m_arr = jax.lax.fori_loop(0, nbits, body, m_arr)
+        rows = _add_rows(cs, _rows_in(m_arr, L, C), _rows_in(add_ref, L, C))
+        _rows_out(out_ref, rows, L)
+
+    B = p_t.shape[-1]
+    spec = _point_spec(cs)
+    bits_spec = pl.BlockSpec((nbits, BLOCK), lambda i: (0, i), memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        kernel,
+        grid=(B // BLOCK,),
+        in_specs=[spec, spec, bits_spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((C * L, B), jnp.uint32),
+        interpret=interpret,
+    )(p_t, add_t, bits_t)
+
+
 def _to_tiles(cs: CurveSpec, pts: jax.Array) -> tuple[jax.Array, tuple, int]:
-    """(..., 4, L) -> ((4L, B_padded), batch_shape, n)."""
-    L = cs.field.limbs
+    """(..., C, L) -> ((C·L, B_padded), batch_shape, n)."""
+    L, C = cs.field.limbs, cs.ncoords
     batch = pts.shape[:-2]
     n = 1
     for d in batch:
         n *= int(d)
     m = max(BLOCK, ((n + BLOCK - 1) // BLOCK) * BLOCK)
-    flat = jnp.reshape(pts, (n, 4 * L))
+    flat = jnp.reshape(pts, (n, C * L))
     if m != n:
-        # pad with the identity (0, 1, 1, 0) so padding lanes stay valid
-        ident = np.zeros((4, L), np.uint32)
+        # pad with the identity so padding lanes stay on-curve
+        ident = np.zeros((C, L), np.uint32)
         ident[1, 0] = 1
-        ident[2, 0] = 1
+        if cs.kind == "edwards":
+            ident[2, 0] = 1
         flat = jnp.concatenate(
-            [flat, jnp.broadcast_to(jnp.asarray(ident.reshape(-1)), (m - n, 4 * L))]
+            [flat, jnp.broadcast_to(jnp.asarray(ident.reshape(-1)), (m - n, C * L))]
         )
     return flat.T, batch, n
 
 
 def _from_tiles(cs: CurveSpec, t: jax.Array, batch: tuple, n: int) -> jax.Array:
-    L = cs.field.limbs
-    return jnp.reshape(t.T[:n], batch + (4, L))
+    L, C = cs.field.limbs, cs.ncoords
+    return jnp.reshape(t.T[:n], batch + (C, L))
 
 
 def _interp() -> bool:
-    return jax.default_backend() != "tpu"
+    from ..fields import device as fd
+
+    return not fd._on_tpu()
 
 
-def ed_add(cs: CurveSpec, p: jax.Array, q: jax.Array, *, interpret: bool | None = None) -> jax.Array:
-    """Fused-kernel twin of groups.device.add for Edwards curves.
+def pt_add(cs: CurveSpec, p: jax.Array, q: jax.Array, *, interpret: bool | None = None) -> jax.Array:
+    """Fused-kernel twin of groups.device.add (both curve kinds).
 
-    p, q: (..., 4, L) extended points (same batch shape)."""
+    p, q: (..., C, L) projective/extended points (batches broadcast)."""
     if not HAVE_PALLAS:  # pragma: no cover
         from ..groups import device as gd
 
-        return gd.add(cs, p, q)
+        return gd._add_xla(cs, p, q)
     p, q = jnp.broadcast_arrays(jnp.asarray(p, jnp.uint32), jnp.asarray(q, jnp.uint32))
     p_t, batch, n = _to_tiles(cs, p)
     q_t, _, _ = _to_tiles(cs, q)
-    out = _ed_add_call(cs, p_t, q_t, _interp() if interpret is None else interpret)
+    out = _add_call(cs, p_t, q_t, _interp() if interpret is None else interpret)
     return _from_tiles(cs, out, batch, n)
 
 
-def ed_window_step(
-    cs: CurveSpec, acc: jax.Array, entry: jax.Array, n_doubles: int = 4, *, interpret: bool | None = None
-) -> jax.Array:
-    """acc <- 2^n_doubles * acc + entry, fused in one kernel launch."""
+def pt_double(cs: CurveSpec, p: jax.Array, n_doubles: int = 1, *, interpret: bool | None = None) -> jax.Array:
+    """Fused 2^n_doubles·P in one launch."""
     if not HAVE_PALLAS:  # pragma: no cover
         from ..groups import device as gd
 
         for _ in range(n_doubles):
-            acc = gd.double(cs, acc)
-        return gd.add(cs, acc, entry)
+            p = gd._double_xla(cs, p)
+        return p
+    p = jnp.asarray(p, jnp.uint32)
+    p_t, batch, n = _to_tiles(cs, p)
+    out = _double_call(cs, p_t, n_doubles, _interp() if interpret is None else interpret)
+    return _from_tiles(cs, out, batch, n)
+
+
+def pt_window_step(
+    cs: CurveSpec, acc: jax.Array, entry: jax.Array, n_doubles: int = 4, *, interpret: bool | None = None
+) -> jax.Array:
+    """acc <- 2^n_doubles · acc + entry, fused in one kernel launch."""
+    if not HAVE_PALLAS:  # pragma: no cover
+        from ..groups import device as gd
+
+        for _ in range(n_doubles):
+            acc = gd._double_xla(cs, acc)
+        return gd._add_xla(cs, acc, entry)
     acc, entry = jnp.broadcast_arrays(
         jnp.asarray(acc, jnp.uint32), jnp.asarray(entry, jnp.uint32)
     )
     acc_t, batch, n = _to_tiles(cs, acc)
     entry_t, _, _ = _to_tiles(cs, entry)
-    out = _ed_window_call(
+    out = _window_call(
         cs, acc_t, n_doubles, _interp() if interpret is None else interpret, entry_t
     )
     return _from_tiles(cs, out, batch, n)
+
+
+def pt_ladder_mul_add(
+    cs: CurveSpec,
+    p: jax.Array,
+    addend: jax.Array,
+    x: jax.Array,
+    nbits: int,
+    *,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """x·P + A for small public per-lane integers x < 2**nbits, fused.
+
+    p, addend: (..., C, L); x: (...,) uint32.  One kernel launch runs
+    the whole nbits-step ladder — this is eval_point_poly's Horner step
+    (acc <- x·acc + E_l) collapsed from ~2·nbits XLA ops into one.
+    """
+    if not HAVE_PALLAS:  # pragma: no cover — XLA ladder, no re-dispatch
+        from ..groups import device as gd
+
+        bits = (
+            jnp.asarray(x, jnp.uint32)[..., None]
+            >> jnp.arange(nbits - 1, -1, -1, dtype=jnp.uint32)
+        ) & 1
+        acc = gd.identity(cs, jnp.asarray(p).shape[:-2])
+        for i in range(nbits):
+            acc = gd._double_xla(cs, acc)
+            acc = gd.select(
+                bits[..., i] != 0, gd._add_xla(cs, acc, p), acc
+            )
+        return gd._add_xla(cs, acc, addend)
+    p, addend = jnp.broadcast_arrays(
+        jnp.asarray(p, jnp.uint32), jnp.asarray(addend, jnp.uint32)
+    )
+    x = jnp.broadcast_to(jnp.asarray(x, jnp.uint32), p.shape[:-2])
+    p_t, batch, n = _to_tiles(cs, p)
+    a_t, _, _ = _to_tiles(cs, addend)
+    B = p_t.shape[-1]
+    xf = jnp.reshape(x, (n,))
+    if B != n:
+        xf = jnp.concatenate([xf, jnp.zeros((B - n,), jnp.uint32)])
+    # MSB-first bit rows: bits_t[i] = bit (nbits-1-i) of x
+    shifts = jnp.arange(nbits - 1, -1, -1, dtype=jnp.uint32)
+    bits_t = (xf[None, :] >> shifts[:, None]) & jnp.uint32(1)
+    out = _ladder_call(
+        cs, p_t, a_t, nbits, _interp() if interpret is None else interpret, bits_t
+    )
+    return _from_tiles(cs, out, batch, n)
+
+
+# Backwards-compatible Edwards aliases (round-1 API).
+def ed_add(cs: CurveSpec, p: jax.Array, q: jax.Array, *, interpret: bool | None = None) -> jax.Array:
+    return pt_add(cs, p, q, interpret=interpret)
+
+
+def ed_window_step(
+    cs: CurveSpec, acc: jax.Array, entry: jax.Array, n_doubles: int = 4, *, interpret: bool | None = None
+) -> jax.Array:
+    return pt_window_step(cs, acc, entry, n_doubles, interpret=interpret)

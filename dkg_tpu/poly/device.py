@@ -33,14 +33,24 @@ def eval_many(fs: FieldSpec, coeffs: jax.Array, xs: jax.Array) -> jax.Array:
     """
     # scan MSB-first over coefficients: acc = acc*x + c_k
     cs_rev = jnp.moveaxis(coeffs, -2, 0)[::-1]  # (T, ..., L)
+    batch = jnp.broadcast_shapes(coeffs.shape[:-2], xs.shape[:-2])
+    init = fd.zeros(fs, batch + (xs.shape[-2],))
+
+    if fd.fused_kernels_active():
+        from ..ops import pallas_field
+
+        def step_fused(acc, c):
+            # one launch per Horner step: acc <- acc*x + c
+            return pallas_field.mod_madd(fs, acc, xs, c[..., None, :]), None
+
+        acc, _ = lax.scan(step_fused, init, cs_rev)
+        return acc
 
     def step(acc, c):
         # acc: (..., N, L); c: (..., L) broadcast over N
         acc = fd.mul(fs, acc, xs)
         return fd.add(fs, acc, c[..., None, :]), None
 
-    batch = jnp.broadcast_shapes(coeffs.shape[:-2], xs.shape[:-2])
-    init = fd.zeros(fs, batch + (xs.shape[-2],))
     acc, _ = lax.scan(step, init, cs_rev)
     return acc
 

@@ -1,0 +1,96 @@
+"""Component-level timing of the bench workload (deal + verify_batch)
+at n=1024 t=341 secp256k1 on the real chip.  Coarse (seconds-scale)
+but trustworthy: each stage is block_until_ready'd."""
+from __future__ import annotations
+
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/dkg_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from dkg_tpu.dkg import ceremony as ce
+from dkg_tpu.fields import device as fd
+from dkg_tpu.groups import device as gd
+
+N, T = int(sys.argv[1]) if len(sys.argv) > 1 else 1024, None
+T = (N - 1) // 3
+
+c = ce.BatchedCeremony("secp256k1", N, T, b"bench", random.Random(7))
+cfg = c.cfg
+cs = cfg.cs
+fs = cs.scalar
+
+
+def timed(name, fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    print(f"{name:26s} {time.perf_counter()-t0:8.3f} s", flush=True)
+    return out
+
+
+print(f"n={N} t={T} curve=secp256k1 platform={jax.devices()[0].platform}", flush=True)
+
+# --- deal components -------------------------------------------------------
+fb = jax.jit(lambda tab, k: gd.fixed_base_mul(cs, tab, k))
+a_pub = timed("deal: fixed_base g (n,t+1)", fb, c.g_table, c.coeffs_a)
+b_hid = timed("deal: fixed_base h (n,t+1)", fb, c.h_table, c.coeffs_b)
+e_comm = timed("deal: point add", jax.jit(lambda p, q: gd.add(cs, p, q)), a_pub, b_hid)
+
+from dkg_tpu.poly import device as pdev
+
+xs = jnp.arange(1, cfg.n + 1, dtype=jnp.uint32)
+xs_limbs = jnp.zeros((cfg.n, fs.limbs), jnp.uint32).at[:, 0].set(xs)
+shares = timed(
+    "deal: eval_many (n,n)",
+    jax.jit(lambda co, x: pdev.eval_many(fs, co, x)),
+    c.coeffs_a,
+    xs_limbs,
+)
+hidings = timed(
+    "deal: eval_many 2", jax.jit(lambda co, x: pdev.eval_many(fs, co, x)), c.coeffs_b, xs_limbs
+)
+
+# --- verify components -----------------------------------------------------
+rho_bits = 128
+rho = jnp.asarray(ce.derive_rho(cfg, a_pub, e_comm, shares, hidings, rho_bits))
+
+s_rlc = timed(
+    "verify: field_dot s", jax.jit(lambda w, v: ce._field_dot(fs, w, v)), rho, shares
+)
+r_rlc = timed(
+    "verify: field_dot r", jax.jit(lambda w, v: ce._field_dot(fs, w, v)), rho, hidings
+)
+d_comm = timed(
+    "verify: point_rlc (128b)",
+    jax.jit(lambda w, p: ce._point_rlc(cs, w, p, rho_bits)),
+    rho,
+    e_comm,
+)
+rhs = timed(
+    "verify: eval_point_poly",
+    jax.jit(lambda d: gd.eval_point_poly(cs, d, xs, cfg.index_bits)),
+    d_comm,
+)
+lhs = timed(
+    "verify: 2 fixed_base (n,)",
+    jax.jit(
+        lambda s_, r_: gd.add(
+            cs, gd.fixed_base_mul(cs, c.g_table, s_), gd.fixed_base_mul(cs, c.h_table, r_)
+        )
+    ),
+    s_rlc,
+    r_rlc,
+)
+ok = timed("verify: eq", jax.jit(lambda p, q: gd.eq(cs, p, q)), lhs, rhs)
+print("all ok:", bool(jnp.all(ok)), flush=True)

@@ -1,0 +1,77 @@
+"""Establish trustworthy timing semantics on the axon platform.
+
+For verify_batch at n=1024: time (a) repeat call with SAME args,
+(b) call with FRESH rho (different value), (c) readback-forced variants.
+If (a) << (b), the runtime memoizes executions and all same-args
+timings are invalid.
+"""
+from __future__ import annotations
+
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/dkg_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from dkg_tpu.dkg import ceremony as ce
+
+N, T = 1024, 341
+c = ce.BatchedCeremony("secp256k1", N, T, b"bench", random.Random(7))
+cfg = c.cfg
+
+a, e, s, r = ce.deal(cfg, c.coeffs_a, c.coeffs_b, c.g_table, c.h_table)
+jax.block_until_ready((a, e, s, r))
+print("deal dispatched+blocked", flush=True)
+
+rng = np.random.default_rng(1)
+rhos = [
+    jnp.asarray(
+        np.concatenate(
+            [rng.integers(0, 1 << 16, (N, 8), dtype=np.uint32), np.zeros((N, 8), np.uint32)],
+            axis=1,
+        )
+    )
+    for _ in range(4)
+]
+
+
+def vb(rho):
+    return ce.verify_batch(cfg, e, s, r, rho, 128, c.g_table, c.h_table)
+
+
+# compile + settle
+out = vb(rhos[0])
+jax.block_until_ready(out)
+
+t0 = time.perf_counter()
+out1 = vb(rhos[0])  # SAME args as warmup
+jax.block_until_ready(out1)
+t_same = time.perf_counter() - t0
+
+t0 = time.perf_counter()
+out2 = vb(rhos[1])  # FRESH args
+jax.block_until_ready(out2)
+t_fresh = time.perf_counter() - t0
+
+t0 = time.perf_counter()
+out3 = vb(rhos[2])
+_ = np.asarray(out3)  # full readback
+t_fresh_rb = time.perf_counter() - t0
+
+t0 = time.perf_counter()
+out4 = vb(rhos[1])  # repeat of rhos[1]
+jax.block_until_ready(out4)
+t_rep = time.perf_counter() - t0
+
+print(f"same-args repeat : {t_same:8.3f} s")
+print(f"fresh args       : {t_fresh:8.3f} s")
+print(f"fresh + readback : {t_fresh_rb:8.3f} s")
+print(f"repeat of fresh  : {t_rep:8.3f} s")

@@ -6,9 +6,16 @@ Must run before any jax *backend initialisation* (hostmesh.py explains
 the ordering; test_import_hygiene.py guards it).
 """
 
-from dkg_tpu.parallel.hostmesh import force_cpu_mesh
+import os
 
-force_cpu_mesh(8)
+if os.environ.get("DKG_TPU_TEST_BACKEND") == "tpu":
+    # TPU test tier: run on the real chip (Mosaic kernel parity tests
+    # un-skip themselves via jax.default_backend() == "tpu").
+    pass
+else:
+    from dkg_tpu.parallel.hostmesh import force_cpu_mesh
+
+    force_cpu_mesh(8)
 
 # persistent compile cache: the limb-arithmetic graphs are large and
 # recompiling them dominates test wall-clock otherwise

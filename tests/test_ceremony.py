@@ -193,3 +193,24 @@ def test_engine_other_curves_smoke(curve):
     secret = sum(int(row[0]) for row in a) % fs.modulus
     master = gd.to_host(c.cfg.cs, np.asarray(out["master"])[None])[0]
     assert g.eq(master, g.scalar_mul(secret, g.generator()))
+
+
+def test_batch_verify_non_byte_aligned_rho_bits(ceremony):
+    """rho_bits that are not a multiple of 8 (or 4) must still verify an
+    honest transcript: fiat_shamir_rho masks to exactly rho_bits so the
+    field side (_field_dot, all set bits) and point side (_point_rlc,
+    low rho_bits) of the RLC equation see the same weights."""
+    c, out = ceremony
+    cfg = c.cfg
+    for rho_bits in (100, 124):
+        rho_np = ce.derive_rho(
+            cfg, out["bare"], out["randomized"], out["shares"], out["hidings"], rho_bits
+        )
+        assert all(
+            fh.decode_int(cfg.cs.scalar, row) < (1 << rho_bits) for row in rho_np
+        )
+        ok = ce.verify_batch(
+            cfg, out["randomized"], out["shares"], out["hidings"],
+            jnp.asarray(rho_np), rho_bits, c.g_table, c.h_table,
+        )
+        assert np.asarray(ok).all(), rho_bits
