@@ -50,14 +50,15 @@ class DleqZkp:
 
     def verify(self, group: HostGroup, base1, base2, point1, point2) -> bool:
         """Recompute announcements a_i = base_i*z - point_i*e and check the
-        challenge matches (reference: zkp.rs:54-74)."""
+        challenge matches (reference: zkp.rs:54-74).  Proof scalars are
+        public, so verification is vartime like the reference's."""
         a1 = group.sub(
-            group.scalar_mul(self.response, base1),
-            group.scalar_mul(self.challenge, point1),
+            group.scalar_mul_vartime(self.response, base1),
+            group.scalar_mul_vartime(self.challenge, point1),
         )
         a2 = group.sub(
-            group.scalar_mul(self.response, base2),
-            group.scalar_mul(self.challenge, point2),
+            group.scalar_mul_vartime(self.response, base2),
+            group.scalar_mul_vartime(self.challenge, point2),
         )
         return self.challenge == _challenge(
             group, base1, base2, point1, point2, a1, a2

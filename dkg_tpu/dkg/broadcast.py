@@ -40,7 +40,12 @@ from .procedure_keys import MemberCommunicationKey, MemberCommunicationPublicKey
 def check_randomized_share(
     group: HostGroup, ck: CommitmentKey, index: int, share: int, rand: int, coeffs
 ) -> bool:
-    """g*s + h*s' == sum_l index^l * E_l (reference: committee.rs:292-296)."""
+    """g*s + h*s' == sum_l index^l * E_l (reference: committee.rs:292-296).
+
+    The received share is still secret when a recipient runs this check
+    (it only becomes public if a complaint is filed), so the left side
+    uses the constant-structure ladder; the Horner side is public data.
+    """
     lhs = group.add(
         group.scalar_mul(share, group.generator()), group.scalar_mul(rand, ck.h)
     )
@@ -55,10 +60,11 @@ def check_bare_share(group: HostGroup, index: int, share: int, coeffs) -> bool:
 
 
 def _eval_comm(group: HostGroup, index: int, coeffs):
-    """Horner evaluation of a point-coefficient polynomial at ``index``."""
+    """Horner evaluation of a point-coefficient polynomial at ``index``
+    (public commitments and a public party index: vartime is fine)."""
     acc = group.identity()
     for c in reversed(coeffs):
-        acc = group.add(group.scalar_mul(index, acc), c)
+        acc = group.add(group.scalar_mul_vartime(index, acc), c)
     return acc
 
 
@@ -163,28 +169,70 @@ class MisbehavingPartiesRound1:
         accused_broadcast: BroadcastPhase1,
     ) -> bool:
         """True iff the accusation is upheld (the accused misbehaved)
-        (reference: broadcast.rs:50-98).  Steps: locate the ciphertexts
-        addressed to the accuser, verify both disclosed-KEM-key proofs,
-        re-decrypt, and re-run the commitment check with the accuser's
-        index."""
+        (reference: broadcast.rs:50-98)."""
+        return (
+            self.check(group, ck, accuser_index, accuser_pk, accused_broadcast)
+            is None
+        )
+
+    def check(
+        self,
+        group: HostGroup,
+        ck: CommitmentKey,
+        accuser_index: int,
+        accuser_pk: MemberCommunicationPublicKey,
+        accused_broadcast: BroadcastPhase1,
+    ) -> Optional[DkgError]:
+        """None iff the accusation is upheld; otherwise the reason it is
+        rejected, using the reference's taxonomy (broadcast.rs:50-98,
+        226-281).  Steps: locate the ciphertexts addressed to the
+        accuser, verify both disclosed-KEM-key proofs, re-decrypt, and
+        re-run the commitment check with the accuser's index.
+
+        Deliberate deviation: a non-decodable decrypted scalar UPHOLDS
+        the complaint (the dealer sent garbage — committee.rs:318-331's
+        ScalarOutOfBounds complaint kind), where the reference's
+        evidence verifier instead rejects with DecodingToScalarFailed
+        (broadcast.rs:260-267), leaving a garbage-dealing dealer
+        unpunishable via that path.
+        """
+        # NB: a rejected complaint blames the ACCUSER (they filed bad
+        # evidence / a false claim), so rejection errors carry
+        # index=accuser_index — the adjudicator's blame target.
         shares = accused_broadcast.shares_for(accuser_index)
         if shares is None:
-            return False
+            return DkgError(
+                DkgErrorKind.INVALID_PROOF_OF_MISBEHAVIOUR,
+                index=accuser_index,
+                detail="no ciphertext addressed to the accuser",
+            )
         if not self.proof.proof_share.verify(
             group, shares.share_ct, accuser_pk.point, self.proof.symm_key_share
-        ):
-            return False
-        if not self.proof.proof_rand.verify(
+        ) or not self.proof.proof_rand.verify(
             group, shares.randomness_ct, accuser_pk.point, self.proof.symm_key_rand
         ):
-            return False
+            # the disclosed-KEM-key DLEQ proofs are the evidence; a bad
+            # proof is a ZKP failure surfaced as an invalid complaint
+            # (reference maps both to InvalidProofOfMisbehaviour,
+            # broadcast.rs:252-254)
+            return DkgError(
+                DkgErrorKind.INVALID_PROOF_OF_MISBEHAVIOUR,
+                index=accuser_index,
+                detail=DkgErrorKind.ZKP_VERIFICATION_FAILED.value,
+            )
         s, r = self.proof.decrypt_scalars(group, shares)
         if s is None or r is None:
-            # non-decodable scalar: accusation upheld (ScalarOutOfBounds)
-            return True
-        return not check_randomized_share(
+            # upheld: dealer's plaintext does not decode to a scalar
+            return None
+        if check_randomized_share(
             group, ck, accuser_index, s, r, accused_broadcast.committed_coefficients
-        )
+        ):
+            # the share actually verifies: the claimed inequality is
+            # false (reference: broadcast.rs:94)
+            return DkgError(
+                DkgErrorKind.FALSE_CLAIMED_INEQUALITY, index=accuser_index
+            )
+        return None
 
 
 @dataclass(frozen=True)
@@ -229,13 +277,39 @@ class MisbehavingPartiesRound3:
         commitments (so it is the genuinely dealt share) AND the round-3
         bare commitments fail (or are missing) for it
         (reference: broadcast.rs:111-143)."""
+        return (
+            self.check(group, ck, accuser_index, randomized_coeffs, bare_coeffs)
+            is None
+        )
+
+    def check(
+        self,
+        group: HostGroup,
+        ck: CommitmentKey,
+        accuser_index: int,
+        randomized_coeffs,
+        bare_coeffs: Optional[tuple],
+    ) -> Optional[DkgError]:
+        """None iff upheld; otherwise why the complaint is rejected
+        (reference taxonomy, broadcast.rs:111-143)."""
         if not check_randomized_share(
             group, ck, accuser_index, self.share, self.randomness, randomized_coeffs
         ):
-            return False
-        if bare_coeffs is None:
-            return True
-        return not check_bare_share(group, accuser_index, self.share, bare_coeffs)
+            # the disclosed pair is not the genuinely dealt share: the
+            # claimed round-1 equality is false (reference:
+            # broadcast.rs:138).  Blame the accuser, who lied.
+            return DkgError(
+                DkgErrorKind.FALSE_CLAIMED_EQUALITY, index=accuser_index
+            )
+        if bare_coeffs is not None and check_bare_share(
+            group, accuser_index, self.share, bare_coeffs
+        ):
+            # the bare commitments verify too: the claimed round-3
+            # inequality is false (reference: broadcast.rs:140)
+            return DkgError(
+                DkgErrorKind.FALSE_CLAIMED_INEQUALITY, index=accuser_index
+            )
+        return None
 
 
 @dataclass(frozen=True)

@@ -58,7 +58,7 @@ from .procedure_keys import (
     MemberCommunicationPublicKey,
     MemberPublicShare,
     MemberSecretShare,
-    decrypt_shares,
+    decrypt_shares_detailed,
     sort_committee,
 )
 
@@ -282,14 +282,19 @@ class DkgPhase1:
                     DkgError(DkgErrorKind.FETCHED_INVALID_DATA, index=j),
                     None,
                 )
-            s, r = decrypt_shares(group, st.comm_key, mine.share_ct, mine.randomness_ct)
+            (s, r), bad_kind = decrypt_shares_detailed(
+                group, st.comm_key, mine.share_ct, mine.randomness_ct
+            )
             if s is None or r is None:
-                # undecodable scalar -> complaint (committee.rs:318-331)
+                # undecodable scalar -> complaint (committee.rs:318-331);
+                # the complaint carries the precise reason: malformed
+                # bytes (DECODING_TO_SCALAR_FAILED) vs value >= order
+                # (SCALAR_OUT_OF_BOUNDS)
                 st.disqualify(j)
                 complaints.append(
                     MisbehavingPartiesRound1(
                         j,
-                        DkgErrorKind.SCALAR_OUT_OF_BOUNDS,
+                        bad_kind or DkgErrorKind.SCALAR_OUT_OF_BOUNDS,
                         ProofOfMisbehaviour.generate(group, mine, st.comm_key, rng),
                     )
                 )

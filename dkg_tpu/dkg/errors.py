@@ -13,7 +13,9 @@ from dataclasses import dataclass, field
 
 
 class DkgErrorKind(enum.Enum):
-    # (reference: errors.rs:13-68)
+    # Full taxonomy parity with the reference (reference: errors.rs:13-68),
+    # plus DUPLICATE_SENDER (a broadcast-layer condition the reference
+    # leaves to a todo, errors.rs:76).
     SHARE_VALIDITY_FAILED = "share validity check failed"
     FETCHED_INVALID_DATA = "fetched data addressed to a different recipient"
     SCALAR_OUT_OF_BOUNDS = "decrypted share is not a canonical scalar"
@@ -22,6 +24,25 @@ class DkgErrorKind(enum.Enum):
     INSUFFICIENT_SHARES_FOR_RECOVERY = "not enough disclosed shares to recover"
     INVALID_PROOF_OF_MISBEHAVIOUR = "proof of misbehaviour failed to verify"
     DUPLICATE_SENDER = "two broadcasts claim the same sender index"
+    # ZKP verification failed (reference: errors.rs:29-31; ProofError
+    # converts into this via From, errors.rs:70-74 — here via
+    # DkgError.from_proof).
+    ZKP_VERIFICATION_FAILED = "zkp verification failed"
+    # Byte-string -> scalar parse failure (reference: errors.rs:32-35,
+    # raised at broadcast.rs:260-267).
+    DECODING_TO_SCALAR_FAILED = "decoding bytes to scalar failed"
+    # Local master key disagrees with the public state (reference:
+    # errors.rs:44-47; used by callers cross-checking finalise output,
+    # committee.rs:1634, lib.rs:176).
+    INCONSISTENT_MASTER_KEY = "inconsistent master key generation"
+    # Complaint claims an inequality/equality that does not hold
+    # (reference: errors.rs:48-60, raised at broadcast.rs:94,138-140).
+    FALSE_CLAIMED_EQUALITY = "complaint verification: false claimed equality"
+    FALSE_CLAIMED_INEQUALITY = "complaint verification: false claimed inequality"
+    # A qualified-set member should have been dismissed earlier
+    # (reference: errors.rs:61-68 — defined there but never constructed;
+    # kept for taxonomy parity).
+    PARTY_SHOULD_BE_DISQUALIFIED = "qualified member should have been dismissed"
 
 
 @dataclass(frozen=True)
@@ -35,6 +56,11 @@ class DkgError(Exception):
     def __str__(self) -> str:  # pragma: no cover
         where = f" (party {self.index})" if self.index is not None else ""
         return f"{self.kind.value}{where}{': ' + self.detail if self.detail else ''}"
+
+    @classmethod
+    def from_proof(cls, err: "ProofError") -> "DkgError":
+        """ProofError -> DkgError conversion (reference: errors.rs:70-74)."""
+        return cls(DkgErrorKind.ZKP_VERIFICATION_FAILED, detail=err.detail)
 
 
 @dataclass(frozen=True)

@@ -82,15 +82,39 @@ def decrypt_shares(
     ``None`` entries signal non-canonical scalars (reference:
     procedure_keys.rs:88-103 -> ScalarOutOfBounds handling
     committee.rs:318-331)."""
+    (s, r), _ = decrypt_shares_detailed(group, sk, share_ct, randomness_ct)
+    return s, r
+
+
+def decrypt_shares_detailed(
+    group: HostGroup,
+    sk: MemberCommunicationKey,
+    share_ct: HybridCiphertext,
+    randomness_ct: HybridCiphertext,
+):
+    """Like :func:`decrypt_shares` but also reports WHY a value failed:
+    DECODING_TO_SCALAR_FAILED for a malformed byte string (reference:
+    errors.rs:32-35, broadcast.rs:260-267) vs SCALAR_OUT_OF_BOUNDS for
+    well-formed bytes encoding a value >= the group order (reference:
+    errors.rs:15-18).  Returns ((s|None, r|None), kind|None)."""
+    from .errors import DkgErrorKind
+
     fs = group.scalar_field
     pt1, pt2 = open_pair(group, sk.sk, share_ct, randomness_ct)
-    s = int.from_bytes(pt1, "little") if len(pt1) == fs.nbytes else None
-    r = int.from_bytes(pt2, "little") if len(pt2) == fs.nbytes else None
-    if s is not None and s >= fs.modulus:
-        s = None
-    if r is not None and r >= fs.modulus:
-        r = None
-    return s, r
+    kind = None
+    out = []
+    for pt in (pt1, pt2):
+        if len(pt) != fs.nbytes:
+            out.append(None)
+            kind = kind or DkgErrorKind.DECODING_TO_SCALAR_FAILED
+            continue
+        v = int.from_bytes(pt, "little")
+        if v >= fs.modulus:
+            out.append(None)
+            kind = kind or DkgErrorKind.SCALAR_OUT_OF_BOUNDS
+            continue
+        out.append(v)
+    return (out[0], out[1]), kind
 
 
 @dataclass(frozen=True)
@@ -106,3 +130,28 @@ class MasterPublicKey:
         for p in shares:
             acc = group.add(acc, p.point if isinstance(p, MemberPublicShare) else p)
         return cls(acc)
+
+    def check_consistent(self, group: HostGroup, others: list):
+        """Cross-check this master key against other parties' finalise
+        outputs; returns a DkgError(INCONSISTENT_MASTER_KEY) on mismatch,
+        None when consistent.  The caller-side check the reference's
+        walkthrough performs after finalise (reference: lib.rs:172-177,
+        committee.rs:1631-1635; error errors.rs:44-47)."""
+        from .errors import DkgError, DkgErrorKind
+
+        for i, other in enumerate(others):
+            pt = other.point if isinstance(other, MasterPublicKey) else other
+            if not group.eq(self.point, pt):
+                return DkgError(DkgErrorKind.INCONSISTENT_MASTER_KEY, index=i)
+        return None
+
+    def check_reproduced_by(self, group: HostGroup, scalar: int):
+        """Cross-check that g*scalar reproduces this master key (the
+        interpolated-secret oracle, reference: committee.rs:1503-1515);
+        DkgError(INCONSISTENT_MASTER_KEY) on mismatch, None when it
+        matches."""
+        from .errors import DkgError, DkgErrorKind
+
+        if not group.eq(self.point, group.scalar_mul(scalar, group.generator())):
+            return DkgError(DkgErrorKind.INCONSISTENT_MASTER_KEY)
+        return None

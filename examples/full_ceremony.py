@@ -110,15 +110,18 @@ def main(curve=gh.RISTRETTO255, n=3, t=1, rng=None):
         results.append(res)
 
     # --- consistency: one key to rule them all -------------------------
+    # (the caller-side cross-checks from the reference's walkthrough,
+    # lib.rs:172-177 — a mismatch is DkgError(INCONSISTENT_MASTER_KEY))
     master = results[0][0]
-    for mk, _ in results[1:]:
-        assert group.eq(mk.point, master.point)
+    err = master.check_consistent(group, [mk for mk, _ in results[1:]])
+    assert err is None, err
 
     shares = [r[1].value for r in results]
     secret = lagrange_interpolation(
         group.scalar_field, 0, shares[: t + 1], list(range(1, t + 2))
     )
-    assert group.eq(group.scalar_mul(secret, group.generator()), master.point)
+    err = master.check_reproduced_by(group, secret)
+    assert err is None, err
 
     print(f"ceremony OK: n={n} t={t} curve={group.name}")
     print(f"master public key: {group.encode(master.point).hex()}")

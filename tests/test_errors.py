@@ -1,0 +1,176 @@
+"""Error-taxonomy parity tests (reference: src/errors.rs:4-74).
+
+Every reference DkgError/ProofError variant exists and is produced at
+the same protocol decision points: complaint adjudication returns the
+reference's FalseClaimedEquality / FalseClaimedInequality /
+InvalidProofOfMisbehaviour reasons, ProofError converts to
+ZkpVerificationFailed (errors.rs:70-74), and master-key cross-checks
+yield InconsistentMasterKey (committee.rs:1631-1635, lib.rs:172-177).
+"""
+
+import random
+
+from dkg_tpu.crypto.commitment import CommitmentKey
+from dkg_tpu.crypto.elgamal import seal_pair
+from dkg_tpu.dkg.broadcast import (
+    BroadcastPhase1,
+    EncryptedShares,
+    MisbehavingPartiesRound1,
+    MisbehavingPartiesRound3,
+    ProofOfMisbehaviour,
+)
+from dkg_tpu.dkg.errors import DkgError, DkgErrorKind, ProofError
+from dkg_tpu.dkg.procedure_keys import MasterPublicKey, MemberCommunicationKey
+from dkg_tpu.groups import host as gh
+
+RNG = random.Random(0xE44)
+G = gh.RISTRETTO255
+FS = G.scalar_field
+
+
+def test_taxonomy_covers_reference_variants():
+    # reference errors.rs:13-68 defines 12 DkgError variants; all must
+    # have a counterpart here (plus DUPLICATE_SENDER, ours alone).
+    names = {k.name for k in DkgErrorKind}
+    for required in (
+        "SCALAR_OUT_OF_BOUNDS",
+        "SHARE_VALIDITY_FAILED",
+        "MISBEHAVIOUR_HIGHER_THRESHOLD",
+        "INVALID_PROOF_OF_MISBEHAVIOUR",
+        "ZKP_VERIFICATION_FAILED",
+        "DECODING_TO_SCALAR_FAILED",
+        "FETCHED_INVALID_DATA",
+        "INSUFFICIENT_SHARES_FOR_RECOVERY",
+        "INCONSISTENT_MASTER_KEY",
+        "FALSE_CLAIMED_EQUALITY",
+        "FALSE_CLAIMED_INEQUALITY",
+        "PARTY_SHOULD_BE_DISQUALIFIED",
+        "NOT_ENOUGH_MEMBERS",
+        "DUPLICATE_SENDER",
+    ):
+        assert required in names, required
+
+
+def test_proof_error_converts_to_zkp_verification_failed():
+    # reference: errors.rs:70-74 From<ProofError> for DkgError
+    err = DkgError.from_proof(ProofError(detail="dleq mismatch"))
+    assert err.kind == DkgErrorKind.ZKP_VERIFICATION_FAILED
+    assert "dleq" in err.detail
+
+
+def _deal_one(t, recipient_index, ck):
+    """One honest dealer's round-1 output for a single recipient."""
+    coeffs_a = [FS.rand_int(RNG) for _ in range(t + 1)]
+    coeffs_b = [FS.rand_int(RNG) for _ in range(t + 1)]
+    comm = tuple(
+        G.add(
+            G.scalar_mul(a, G.generator()),
+            G.scalar_mul(b, ck.h),
+        )
+        for a, b in zip(coeffs_a, coeffs_b)
+    )
+    x = recipient_index
+    share = sum(a * pow(x, l, FS.modulus) for l, a in enumerate(coeffs_a)) % FS.modulus
+    rand = sum(b * pow(x, l, FS.modulus) for l, b in enumerate(coeffs_b)) % FS.modulus
+    return coeffs_a, coeffs_b, comm, share, rand
+
+
+def test_false_accusation_yields_false_claimed_inequality():
+    # an honest dealer's share verifies, so the complaint's claimed
+    # inequality is false (reference: broadcast.rs:94)
+    ck = CommitmentKey.generate(G, b"errors-test")
+    accuser_key = MemberCommunicationKey.generate(G, RNG)
+    accuser_pk = accuser_key.public()
+    _, _, comm, share, rand = _deal_one(2, 1, ck)
+    s_ct, r_ct = seal_pair(
+        G,
+        accuser_pk.point,
+        G.scalar_to_bytes(share),
+        G.scalar_to_bytes(rand),
+        RNG,
+    )
+    b1 = BroadcastPhase1(comm, (EncryptedShares(1, s_ct, r_ct),))
+    proof = ProofOfMisbehaviour.generate(G, b1.encrypted_shares[0], accuser_key, RNG)
+    complaint = MisbehavingPartiesRound1(1, DkgErrorKind.SHARE_VALIDITY_FAILED, proof)
+    err = complaint.check(G, ck, 1, accuser_pk, b1)
+    assert err is not None and err.kind == DkgErrorKind.FALSE_CLAIMED_INEQUALITY
+    assert not complaint.verify(G, ck, 1, accuser_pk, b1)
+
+
+def test_bad_evidence_yields_invalid_proof_of_misbehaviour():
+    ck = CommitmentKey.generate(G, b"errors-test")
+    accuser_key = MemberCommunicationKey.generate(G, RNG)
+    other_key = MemberCommunicationKey.generate(G, RNG)
+    accuser_pk = accuser_key.public()
+    _, _, comm, share, rand = _deal_one(2, 1, ck)
+    s_ct, r_ct = seal_pair(
+        G, accuser_pk.point, G.scalar_to_bytes(share), G.scalar_to_bytes(rand), RNG
+    )
+    b1 = BroadcastPhase1(comm, (EncryptedShares(1, s_ct, r_ct),))
+    # evidence generated with the WRONG secret key: DLEQ proofs cannot
+    # verify against the accuser's public key
+    proof = ProofOfMisbehaviour.generate(G, b1.encrypted_shares[0], other_key, RNG)
+    complaint = MisbehavingPartiesRound1(1, DkgErrorKind.SHARE_VALIDITY_FAILED, proof)
+    err = complaint.check(G, ck, 1, accuser_pk, b1)
+    assert err is not None and err.kind == DkgErrorKind.INVALID_PROOF_OF_MISBEHAVIOUR
+
+
+def test_round3_complaint_taxonomy():
+    ck = CommitmentKey.generate(G, b"errors-test")
+    coeffs_a, _, comm, share, rand = _deal_one(2, 1, ck)
+    bare = tuple(G.scalar_mul(a, G.generator()) for a in coeffs_a)
+
+    # disclosed pair is NOT the dealt share -> FalseClaimedEquality
+    # (reference: broadcast.rs:138)
+    bogus = MisbehavingPartiesRound3(1, (share + 1) % FS.modulus, rand)
+    err = bogus.check(G, ck, 1, comm, bare)
+    assert err is not None and err.kind == DkgErrorKind.FALSE_CLAIMED_EQUALITY
+
+    # genuine pair but the bare commitments verify -> FalseClaimedInequality
+    # (reference: broadcast.rs:140)
+    honest = MisbehavingPartiesRound3(1, share, rand)
+    err = honest.check(G, ck, 1, comm, bare)
+    assert err is not None and err.kind == DkgErrorKind.FALSE_CLAIMED_INEQUALITY
+
+    # genuine pair and INCONSISTENT bare commitments -> upheld
+    lying_bare = tuple(G.scalar_mul(a + 1, G.generator()) for a in coeffs_a)
+    assert honest.check(G, ck, 1, comm, lying_bare) is None
+    assert honest.verify(G, ck, 1, comm, lying_bare)
+    # missing bare commitments (silent round 3) -> upheld
+    assert honest.check(G, ck, 1, comm, None) is None
+
+
+def test_master_key_consistency_checks():
+    sk = FS.rand_int(RNG)
+    mk = MasterPublicKey(G.scalar_mul(sk, G.generator()))
+    same = MasterPublicKey(G.scalar_mul(sk, G.generator()))
+    other = MasterPublicKey(G.scalar_mul((sk + 1) % FS.modulus, G.generator()))
+    assert mk.check_consistent(G, [same]) is None
+    err = mk.check_consistent(G, [same, other])
+    assert err is not None and err.kind == DkgErrorKind.INCONSISTENT_MASTER_KEY
+    assert err.index == 1
+    assert mk.check_reproduced_by(G, sk) is None
+    err = mk.check_reproduced_by(G, (sk + 1) % FS.modulus)
+    assert err is not None and err.kind == DkgErrorKind.INCONSISTENT_MASTER_KEY
+
+
+def test_decrypt_shares_detailed_distinguishes_reasons():
+    from dkg_tpu.dkg.procedure_keys import decrypt_shares_detailed
+
+    key = MemberCommunicationKey.generate(G, RNG)
+    pk = key.public().point
+    good = G.scalar_to_bytes(FS.rand_int(RNG))
+    # malformed length -> DECODING_TO_SCALAR_FAILED (reference errors.rs:32-35)
+    short_ct = seal_pair(G, pk, b"\x01\x02\x03", good, RNG)
+    (s, r), kind = decrypt_shares_detailed(G, key, *short_ct)
+    assert s is None and r is not None
+    assert kind == DkgErrorKind.DECODING_TO_SCALAR_FAILED
+    # canonical length but value >= order -> SCALAR_OUT_OF_BOUNDS
+    too_big = (FS.modulus + 1).to_bytes(FS.nbytes, "little")
+    big_ct = seal_pair(G, pk, too_big, good, RNG)
+    (s, r), kind = decrypt_shares_detailed(G, key, *big_ct)
+    assert s is None and kind == DkgErrorKind.SCALAR_OUT_OF_BOUNDS
+    # both fine -> no kind
+    ok_ct = seal_pair(G, pk, good, good, RNG)
+    (s, r), kind = decrypt_shares_detailed(G, key, *ok_ct)
+    assert kind is None and s is not None and r is not None

@@ -127,3 +127,15 @@ def test_secp256k1_generator_order():
 def test_bls12_381_generator_order():
     g = gh.BLS12_381_G1
     assert g.is_identity(_mul_int(g, g.scalar_field.modulus, g.generator()))
+
+
+@pytest.mark.parametrize("g", GROUPS, ids=lambda g: g.name)
+def test_ladder_matches_vartime(g):
+    """The constant-structure Montgomery ladder (secret-scalar path)
+    agrees with vartime double-and-add on edge cases + random scalars."""
+    p = g.generator()
+    fs = g.scalar_field
+    cases = [0, 1, 2, 3, fs.modulus - 1, fs.modulus - 2]
+    cases += [fs.rand_int(RNG) for _ in range(4)]
+    for k in cases:
+        assert g.eq(g.scalar_mul(k, p), g.scalar_mul_vartime(k, p)), k
