@@ -58,6 +58,52 @@ def timed(fn, *args):
     return out, time.perf_counter() - t0
 
 
+def parity_check(curve: str = "secp256k1", n: int = 64, t: int = 21) -> bool:
+    """TPU-vs-CPU bit-exact parity on identical inputs (north-star
+    requirement, BASELINE.json): deal + batch-verify on the default
+    (TPU, fused-kernel) path and on the CPU XLA path, asserting
+    limb-equality of every output tensor.  Returns True iff bit-exact.
+    """
+    import os
+
+    import numpy as np
+
+    from dkg_tpu.dkg import ceremony as ce
+
+    rng = random.Random(0x9A71)
+    c = ce.BatchedCeremony(curve, n, t, b"parity", rng)
+    cfg = c.cfg
+
+    def leg():
+        a, e, s, r = ce.deal(cfg, c.coeffs_a, c.coeffs_b, c.g_table, c.h_table)
+        rho = jnp.asarray(ce.derive_rho(cfg, a, e, s, r, 64))
+        ok = ce.verify_batch(cfg, e, s, r, rho, 64, c.g_table, c.h_table)
+        return [np.asarray(x) for x in (a, e, s, r, ok)]
+
+    tpu_out = leg()
+    # CPU leg: pure-XLA path — disable BOTH fused-kernel families so the
+    # cross-check is against an independent formulation (Pallas point
+    # kernels AND the MXU int8 field matmul).
+    prev = {k: os.environ.get(k) for k in ("DKG_TPU_PALLAS", "DKG_TPU_MXU")}
+    os.environ["DKG_TPU_PALLAS"] = "0"
+    os.environ["DKG_TPU_MXU"] = "0"
+    try:
+        cpu = jax.devices("cpu")[0]
+        with jax.default_device(cpu):
+            c.g_table = jax.device_put(c.g_table, cpu)
+            c.h_table = jax.device_put(c.h_table, cpu)
+            c.coeffs_a = jax.device_put(c.coeffs_a, cpu)
+            c.coeffs_b = jax.device_put(c.coeffs_b, cpu)
+            cpu_out = leg()
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return all(bool((x == y).all()) for x, y in zip(tpu_out, cpu_out))
+
+
 def run(curve: str, n: int, t: int, rho_bits: int = 128):
     from dkg_tpu.dkg import ceremony as ce
 
@@ -85,6 +131,14 @@ def run(curve: str, n: int, t: int, rho_bits: int = 128):
 
 
 def main():
+    import os
+
+    # parity_check needs a CPU backend next to the TPU one; the ambient
+    # env pins JAX_PLATFORMS to the tpu plugin only, so widen it BEFORE
+    # the first jax touch (a platform list initialises all named backends).
+    plat_env = os.environ.get("JAX_PLATFORMS")
+    if plat_env and "cpu" not in plat_env.split(","):
+        jax.config.update("jax_platforms", plat_env + ",cpu")
     jax.config.update("jax_compilation_cache_dir", "/tmp/dkg_tpu_jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     platform = jax.devices()[0].platform
@@ -100,6 +154,11 @@ def main():
             t_deal, t_verify, t_rho = run(curve, n, t)
             pairs = n * (n - 1)
             rate = pairs / t_verify
+            try:
+                parity = parity_check() if platform == "tpu" else None
+            except Exception as exc:  # noqa: BLE001 — parity is reported, not fatal
+                print(f"parity check failed to run: {exc}", file=sys.stderr)
+                parity = False
             print(
                 json.dumps(
                     {
@@ -116,6 +175,7 @@ def main():
                             "verify_s": round(t_verify, 3),
                             "fiat_shamir_s": round(t_rho, 3),
                             "pallas": _pallas_active(),
+                            "tpu_cpu_bit_exact": parity,
                         },
                     }
                 )

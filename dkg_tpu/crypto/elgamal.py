@@ -39,21 +39,71 @@ class Keypair:
 @dataclass(frozen=True)
 class Ciphertext:
     """Lifted-ElGamal ciphertext (e1, e2) = (r*G, m*G + r*PK)
-    (reference: elgamal.rs:38-41)."""
+    (reference: elgamal.rs:38-41).
+
+    Carries its group (compare-excluded) so the Python operators
+    ``a + b``, ``a - b``, ``a * k`` / ``k * a`` work directly — the
+    ergonomic twin of the reference's operator-forwarding macros over
+    every borrow combination (reference: src/macros.rs:3-43,
+    elgamal.rs:219-283).  Constructors thread the group automatically;
+    the explicit ``add/sub/mul_scalar(group, ...)`` forms remain for
+    group-free deserialized values.
+    """
 
     e1: tuple
     e2: tuple
+    group: "HostGroup | None" = None
 
     def add(self, group: HostGroup, other: "Ciphertext") -> "Ciphertext":
         """Homomorphic sum (reference: elgamal.rs:219-234)."""
-        return Ciphertext(group.add(self.e1, other.e1), group.add(self.e2, other.e2))
+        return Ciphertext(
+            group.add(self.e1, other.e1), group.add(self.e2, other.e2), group
+        )
 
     def sub(self, group: HostGroup, other: "Ciphertext") -> "Ciphertext":
-        return Ciphertext(group.sub(self.e1, other.e1), group.sub(self.e2, other.e2))
+        return Ciphertext(
+            group.sub(self.e1, other.e1), group.sub(self.e2, other.e2), group
+        )
 
     def mul_scalar(self, group: HostGroup, k: int) -> "Ciphertext":
         """Homomorphic scalar mult (reference: elgamal.rs:260-283)."""
-        return Ciphertext(group.scalar_mul(k, self.e1), group.scalar_mul(k, self.e2))
+        return Ciphertext(
+            group.scalar_mul(k, self.e1), group.scalar_mul(k, self.e2), group
+        )
+
+    def _require_group(self) -> HostGroup:
+        if self.group is None:
+            raise TypeError(
+                "operator form needs a group-carrying Ciphertext; use "
+                ".add/.sub/.mul_scalar(group, ...) or "
+                "dataclasses.replace(ct, group=g)"
+            )
+        return self.group
+
+    def __add__(self, other):
+        if not isinstance(other, Ciphertext):
+            return NotImplemented
+        return self.add(self._require_group(), other)
+
+    def __sub__(self, other):
+        if not isinstance(other, Ciphertext):
+            return NotImplemented
+        return self.sub(self._require_group(), other)
+
+    def __mul__(self, k):
+        if not isinstance(k, int):
+            return NotImplemented
+        return self.mul_scalar(self._require_group(), k)
+
+    __rmul__ = __mul__
+
+    def __eq__(self, other):  # group is context, not content
+        if not isinstance(other, Ciphertext):
+            return NotImplemented
+        return self.e1 == other.e1 and self.e2 == other.e2
+
+    def __hash__(self):
+        return hash((self.e1, self.e2))
 
 
 def encrypt_point(group: HostGroup, pk: tuple, m_point: tuple, rng) -> Ciphertext:
@@ -67,7 +117,7 @@ def encrypt_point_with_random(
 ) -> Ciphertext:
     e1 = group.scalar_mul(r, group.generator())
     e2 = group.add(m_point, group.scalar_mul(r, pk))
-    return Ciphertext(e1, e2)
+    return Ciphertext(e1, e2, group)
 
 
 def encrypt(group: HostGroup, pk: tuple, m: int, rng) -> Ciphertext:

@@ -323,6 +323,58 @@ def _fold_digest(cfg: CeremonyConfig, a_np: np.ndarray, e_np: np.ndarray,
     return h.digest()
 
 
+def _fold_digest_device(cfg: CeremonyConfig, da, de, rows) -> bytes:
+    """Outer fold shared by the flat and sharded device digests: binds
+    the two Merkle roots + all dealer row digests in dealer order."""
+    from ..crypto import device_hash as dh
+
+    h = hashlib.blake2b(digest_size=32, person=b"dkgtpu-trd")
+    h.update(f"{cfg.curve}|{cfg.n}|{cfg.t}|".encode())
+    h.update(dh.digest_to_bytes(da))
+    h.update(dh.digest_to_bytes(de))
+    h.update(np.ascontiguousarray(np.asarray(rows, np.uint32)))
+    return h.digest()
+
+
+def _row_digests_device(cfg: CeremonyConfig, shares, hidings) -> jax.Array:
+    """(k, n, L) x2 dealer rows -> (k, 8) uint32 BLAKE2s row digests;
+    depends only on each dealer's own rows, so shards hash locally."""
+    from ..crypto import device_hash as dh
+
+    k = shares.shape[0]
+    sr = jnp.concatenate(
+        [
+            jnp.asarray(shares, jnp.uint32).reshape(k, -1),
+            jnp.asarray(hidings, jnp.uint32).reshape(k, -1),
+        ],
+        axis=-1,
+    )
+    return dh.row_digests(sr, domain=3)
+
+
+def transcript_digest_device(
+    cfg: CeremonyConfig, a_comm, e_comm, shares, hidings
+) -> bytes:
+    """THE canonical engine transcript digest (device-resident).
+
+    Same binding guarantee as the byte-level :func:`transcript_digest`
+    (every limb of all four round-1 tensors), different digest function:
+    the tensors are hashed where they live with the BLAKE2s Merkle tree
+    (crypto.device_hash) and only 32-byte roots + (n, 32)-byte dealer
+    row digests reach the host — instead of shipping ~2 GB of share
+    matrices at n=4096.  Shard-foldable: each dealer's row digest
+    depends only on that dealer's rows, so sharded meshes exchange 32
+    bytes per dealer (:func:`sharded_transcript_digest` computes this
+    exact value from dealer-sharded arrays).
+    """
+    from ..crypto import device_hash as dh
+
+    da = dh.tree_digest(a_comm, domain=1)
+    de = dh.tree_digest(e_comm, domain=2)
+    rows = _row_digests_device(cfg, shares, hidings)  # (n, 8)
+    return _fold_digest_device(cfg, da, de, rows)
+
+
 def transcript_digest(cfg: CeremonyConfig, a_comm, e_comm, shares, hidings) -> bytes:
     """Digest of the COMPLETE round-1 broadcast transcript.
 
@@ -334,28 +386,33 @@ def transcript_digest(cfg: CeremonyConfig, a_comm, e_comm, shares, hidings) -> b
     any part of its round-1 output without changing the derived batch
     randomizers.
 
-    Structure is canonical AND shard-foldable: commitments are hashed
-    flat (they are replicated after the round-1 allgather), while the
-    share matrices enter via per-dealer row digests folded in dealer
-    order — so :func:`sharded_transcript_digest` can compute the exact
-    same value from dealer-sharded arrays without materializing them on
-    any single host.
+    Structure is canonical and byte-level — the wire/audit alternative
+    to the canonical engine digest (:func:`transcript_digest_device`);
+    callers must pick ONE digest family per ceremony, and every engine
+    path (BatchedCeremony, bench, sharded, driver entry) uses the
+    device family via :func:`derive_rho`'s default.
     """
     rows = _dealer_row_digests(np.asarray(shares), np.asarray(hidings))
     return _fold_digest(cfg, np.asarray(a_comm), np.asarray(e_comm), rows)
 
 
 def sharded_transcript_digest(cfg: CeremonyConfig, a_all, e_all, s, r) -> bytes:
-    """transcript_digest over mesh-sharded round-1 output.
+    """transcript_digest_device over mesh-sharded round-1 output.
 
     a_all/e_all are replicated (locally addressable on every process);
-    s/r are dealer-sharded.  Each process digests its local dealer rows;
-    only the 32-byte row digests cross process boundaries, so this works
-    on multi-host meshes where ``np.asarray(s)`` would fail (shards on
-    non-addressable devices).  Bit-identical to ``transcript_digest`` on
-    the unsharded arrays.
+    s/r are dealer-sharded.  Each process Merkle-hashes its local dealer
+    rows ON DEVICE; only the 32-byte row digests cross process
+    boundaries, so this works on multi-host meshes where
+    ``np.asarray(s)`` would fail (shards on non-addressable devices).
+    Bit-identical to ``transcript_digest_device`` on the unsharded
+    arrays — the sharded and single-chip engines derive the SAME rho
+    from the same transcript.
     """
-    rows = np.zeros((cfg.n, 32), np.uint8)
+    from ..crypto import device_hash as dh
+
+    da = dh.tree_digest(a_all, domain=1)
+    de = dh.tree_digest(e_all, domain=2)
+    rows = np.zeros((cfg.n, 8), np.uint32)
     shards_s = sorted(s.addressable_shards, key=lambda sh: sh.index[0].start or 0)
     shards_r = sorted(r.addressable_shards, key=lambda sh: sh.index[0].start or 0)
     seen = set()
@@ -365,14 +422,14 @@ def sharded_transcript_digest(cfg: CeremonyConfig, a_all, e_all, s, r) -> bytes:
         if (sl.start, sl.stop) in seen:  # replicated shard copy
             continue
         seen.add((sl.start, sl.stop))
-        rows[sl] = _dealer_row_digests(np.asarray(sh_s.data), np.asarray(sh_r.data))
+        rows[sl] = np.asarray(_row_digests_device(cfg, sh_s.data, sh_r.data))
     if jax.process_count() > 1:  # pragma: no cover — single-process CI
         from jax.experimental import multihost_utils as mhu
 
         gathered = np.asarray(mhu.process_allgather(jnp.asarray(rows)))
         # each dealer row is owned by exactly one process; others are 0
         rows = np.bitwise_or.reduce(gathered, axis=0)
-    return _fold_digest(cfg, np.asarray(a_all), np.asarray(e_all), rows)
+    return _fold_digest_device(cfg, da, de, rows)
 
 
 def fiat_shamir_rho(cfg: CeremonyConfig, transcript: bytes, rho_bits: int) -> np.ndarray:
@@ -398,7 +455,8 @@ def fiat_shamir_rho(cfg: CeremonyConfig, transcript: bytes, rho_bits: int) -> np
 
 
 def derive_rho(
-    cfg: CeremonyConfig, a_comm, e_comm, shares, hidings, rho_bits: int
+    cfg: CeremonyConfig, a_comm, e_comm, shares, hidings, rho_bits: int,
+    *, device: bool = True,
 ) -> np.ndarray:
     """rho from the real round-1 transcript — the only sound way to get
     batch randomizers (every caller path: engine, bench, sharded,
@@ -408,9 +466,14 @@ def derive_rho(
     bound too: they feed ``master_key_from_bare`` and (in the reference,
     round 4) the second share check, so a dealer must not be able to
     pick A after seeing rho any more than E/s/r.
+
+    ``device=True`` (default) hashes the tensors on-device
+    (:func:`transcript_digest_device`) so only digests cross to host;
+    ``device=False`` uses the byte-level host digest.
     """
+    digest_fn = transcript_digest_device if device else transcript_digest
     return fiat_shamir_rho(
-        cfg, transcript_digest(cfg, a_comm, e_comm, shares, hidings), rho_bits
+        cfg, digest_fn(cfg, a_comm, e_comm, shares, hidings), rho_bits
     )
 
 
@@ -436,13 +499,38 @@ class BatchedCeremony:
             fh.encode(fs, [[fs.rand_int(rng) for _ in range(t + 1)] for _ in range(n)])
         )
 
-    def run(self, rho_bits: int = 128, trace=None):
-        """Happy-path ceremony; returns dict of device results.  Pass a
-        :class:`dkg_tpu.utils.tracing.CeremonyTrace` to collect per-phase
-        wall-clock + device profiler annotations."""
+    def run(self, rho_bits: int = 128, trace=None, tamper=None):
+        """Full ceremony over device arrays, including the blame path.
+
+        Happy path: one RLC batch verification covers all n·(n-1) pair
+        relations.  If ANY recipient's batch check fails, the engine
+        drops to per-pair blame assignment (``verify_pairwise`` — the
+        reference's complaint trigger, committee.rs:305-317), records
+        one complaint per failing (recipient, dealer) pair, disqualifies
+        the guilty dealers (the engine is its own adjudicator: it holds
+        the plaintext share matrix, so re-checking IS adjudication —
+        the wire path's evidence/DLEQ machinery lives in
+        complaints_batch.adjudicate_round1_batch), and completes the
+        ceremony over the qualified set (committee.rs:369-398, 453-462).
+
+        Aborts with DkgError(MISBEHAVIOUR_HIGHER_THRESHOLD) when more
+        than t dealers are disqualified (committee.rs:340-347).
+
+        Returns a dict of device results; ``complaints`` is a list of
+        (accuser_recipient_index, accused_dealer_index) 1-based pairs
+        (empty on the happy path) and ``qualified`` the final dealer
+        mask.  Pass a :class:`dkg_tpu.utils.tracing.CeremonyTrace` to
+        collect per-phase wall-clock + device profiler annotations.
+
+        ``tamper`` is a fault-injection hook for tests: called as
+        ``tamper(a, e, s, r) -> (a, e, s, r)`` after dealing, it plays
+        the role of the reference tests' hand-corrupted broadcasts
+        (committee.rs:1127-1128, 1188).
+        """
         import jax as _jax
 
         from ..utils.tracing import phase_span
+        from .errors import DkgError, DkgErrorKind
 
         cfg = self.cfg
         with phase_span(trace, "deal"):
@@ -450,12 +538,43 @@ class BatchedCeremony:
                 cfg, self.coeffs_a, self.coeffs_b, self.g_table, self.h_table
             )
             _jax.block_until_ready(e)
+        if tamper is not None:
+            a, e, s, r = tamper(a, e, s, r)
         rho = jnp.asarray(derive_rho(cfg, a, e, s, r, rho_bits))
         with phase_span(trace, "verify"):
             ok = verify_batch(cfg, e, s, r, rho, rho_bits, self.g_table, self.h_table)
             _jax.block_until_ready(ok)
+
+        qualified = jnp.ones((cfg.n,), bool)
+        complaints: list[tuple[int, int]] = []
+        if not bool(np.asarray(ok).all()):
+            with phase_span(trace, "blame"):
+                pw = np.asarray(
+                    verify_pairwise(cfg, e, s, r, self.g_table, self.h_table)
+                )  # (n_dealers, n_recipients)
+                guilty = ~pw.all(axis=1)
+                complaints = [
+                    (int(i) + 1, int(j) + 1)
+                    for j, i in zip(*np.nonzero(~pw))
+                ]
+                qualified = jnp.asarray(~guilty)
+            if int(guilty.sum()) > cfg.t:
+                if trace is not None:
+                    trace.meta.update(
+                        {"curve": cfg.curve, "n": cfg.n, "t": cfg.t}
+                    )
+                return {
+                    "bare": a,
+                    "randomized": e,
+                    "shares": s,
+                    "hidings": r,
+                    "ok": ok,
+                    "qualified": qualified,
+                    "complaints": complaints,
+                    "error": DkgError(DkgErrorKind.MISBEHAVIOUR_HIGHER_THRESHOLD),
+                }
+
         with phase_span(trace, "finalise"):
-            qualified = jnp.ones((cfg.n,), bool)
             final_shares = aggregate_shares(cfg, s, qualified)
             master = master_key_from_bare(cfg, a, qualified)
             _jax.block_until_ready(master)
@@ -467,6 +586,8 @@ class BatchedCeremony:
             "shares": s,
             "hidings": r,
             "ok": ok,
+            "qualified": qualified,
+            "complaints": complaints,
             "final_shares": final_shares,
             "master": master,
         }

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Generate the static API reference (docs/api/) from docstrings.
+
+Dependency-free (stdlib inspect + html): walks the dkg_tpu package,
+emits one HTML page per module with class/function signatures and
+docstrings, KaTeX-enabled via docs/katex-header.html so $...$ math in
+docstrings renders (the counterpart of the reference's rustdoc +
+katex-header.html pipeline).
+
+Usage:  python docs/build_api.py        (writes docs/api/*.html)
+"""
+
+from __future__ import annotations
+
+import html
+import importlib
+import inspect
+import pkgutil
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+STYLE = """
+body { font: 15px/1.5 system-ui, sans-serif; max-width: 60rem;
+       margin: 2rem auto; padding: 0 1rem; color: #1a1a1a; }
+pre, code { background: #f4f4f5; border-radius: 4px; font-size: 0.92em; }
+pre { padding: 0.7em 0.9em; overflow-x: auto; white-space: pre-wrap; }
+h2 { border-bottom: 1px solid #ddd; padding-bottom: 0.2em; }
+.sig { background: #eef2f7; padding: 0.5em 0.8em; border-radius: 4px;
+       font-family: ui-monospace, monospace; font-size: 0.9em; }
+.doc { margin: 0.5em 0 1.5em 1.5em; }
+nav a { margin-right: 1em; }
+"""
+
+
+def _header() -> str:
+    katex = (ROOT / "docs" / "katex-header.html").read_text()
+    return f"<meta charset='utf-8'>{katex}<style>{STYLE}</style>"
+
+
+def _doc_html(obj) -> str:
+    doc = inspect.getdoc(obj) or ""
+    return f"<pre class='doc'>{html.escape(doc)}</pre>" if doc else ""
+
+
+def _sig(obj) -> str:
+    try:
+        return html.escape(str(inspect.signature(obj)))
+    except (ValueError, TypeError):
+        return "(...)"
+
+
+def render_module(modname: str) -> str:
+    mod = importlib.import_module(modname)
+    parts = [
+        f"<!DOCTYPE html><html><head><title>{modname}</title>{_header()}</head><body>",
+        "<nav><a href='index.html'>index</a><a href='../protocol.html'>protocol</a></nav>",
+        f"<h1><code>{modname}</code></h1>",
+        _doc_html(mod),
+    ]
+    members = [
+        (name, obj)
+        for name, obj in vars(mod).items()
+        if not name.startswith("_")
+        and (inspect.isclass(obj) or inspect.isfunction(obj))
+        and getattr(obj, "__module__", None) == modname
+    ]
+    for name, obj in members:
+        if inspect.isclass(obj):
+            parts.append(f"<h2 id='{name}'>class <code>{name}</code></h2>")
+            parts.append(_doc_html(obj))
+            for mname, meth in vars(obj).items():
+                func = meth.__func__ if isinstance(meth, classmethod) else meth
+                if mname.startswith("_") or not inspect.isfunction(func):
+                    continue
+                parts.append(
+                    f"<div class='sig'>{name}.{mname}{_sig(func)}</div>"
+                )
+                parts.append(_doc_html(func))
+        else:
+            parts.append(f"<h2 id='{name}'><code>{name}</code></h2>")
+            parts.append(f"<div class='sig'>{name}{_sig(obj)}</div>")
+            parts.append(_doc_html(obj))
+    parts.append("</body></html>")
+    return "\n".join(parts)
+
+
+def main() -> None:
+    import dkg_tpu
+
+    outdir = ROOT / "docs" / "api"
+    outdir.mkdir(parents=True, exist_ok=True)
+    modules = ["dkg_tpu"]
+    for info in pkgutil.walk_packages(dkg_tpu.__path__, prefix="dkg_tpu."):
+        if ".native" in info.name:
+            continue  # ctypes loader: importing may build the C library
+        modules.append(info.name)
+    written = []
+    for m in sorted(modules):
+        try:
+            out = render_module(m)
+        except Exception as exc:  # pragma: no cover — skip unimportables
+            print(f"skip {m}: {exc}", file=sys.stderr)
+            continue
+        (outdir / f"{m}.html").write_text(out)
+        written.append(m)
+    index = [
+        f"<!DOCTYPE html><html><head><title>dkg_tpu API</title>{_header()}</head><body>",
+        "<h1>dkg_tpu API reference</h1>",
+        "<p><a href='../protocol.html'>Protocol walkthrough (rendered math)</a></p>",
+        "<ul>",
+        *(f"<li><a href='{m}.html'><code>{m}</code></a></li>" for m in written),
+        "</ul></body></html>",
+    ]
+    (outdir / "index.html").write_text("\n".join(index))
+    print(f"wrote {len(written)} module pages to {outdir}")
+
+
+if __name__ == "__main__":
+    main()

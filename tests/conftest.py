@@ -17,9 +17,23 @@ else:
 
     force_cpu_mesh(8)
 
-# persistent compile cache: the limb-arithmetic graphs are large and
-# recompiling them dominates test wall-clock otherwise
+# Persistent compile cache policy.
+#
+# CPU tier: OFF by default.  Serializing/deserializing this package's
+# very large XLA:CPU executables has segfaulted repeatedly inside the
+# cache writer AND reader (jax compilation_cache put/get_executable) on
+# this image — a poisoned entry then crashes every later run.  Paying
+# the recompiles is slower but reliable; DKG_TPU_TEST_CACHE=1 opts back
+# in for local iteration (delete the dir if a run ever segfaults in
+# compilation_cache.py).
+#
+# TPU tier: ON (separate dir) — those executables serialize fine and
+# tunnel compiles are expensive.
 import jax
 
-jax.config.update("jax_compilation_cache_dir", "/tmp/dkg_tpu_jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+if os.environ.get("DKG_TPU_TEST_BACKEND") == "tpu":
+    jax.config.update("jax_compilation_cache_dir", "/tmp/dkg_tpu_jax_cache_tputest")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+elif os.environ.get("DKG_TPU_TEST_CACHE") == "1":
+    jax.config.update("jax_compilation_cache_dir", "/tmp/dkg_tpu_jax_cache_cputest")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -214,3 +214,62 @@ def test_batch_verify_non_byte_aligned_rho_bits(ceremony):
             jnp.asarray(rho_np), rho_bits, c.g_table, c.h_table,
         )
         assert np.asarray(ok).all(), rho_bits
+
+
+def test_run_blame_path_disqualifies_cheating_dealer():
+    """An injected cheat makes run() drop from the batch check to
+    pairwise blame, record complaints, disqualify the dealer, and finish
+    over the qualified set (reference flow committee.rs:305-317,
+    369-398, 453-462)."""
+    c = ce.BatchedCeremony("ristretto255", 8, 3, b"blame", random.Random(5))
+    fs = c.cfg.cs.scalar
+
+    def cheat(a, e, s, r):
+        bad = np.asarray(s).copy()
+        # dealer 3 (index 2) deals garbage to recipients 1 and 5
+        for i in (0, 4):
+            bad[2, i] = fh.encode(fs, (fh.decode_int(fs, bad[2, i]) + 7) % fs.modulus)
+        return a, e, jnp.asarray(bad), r
+
+    out = c.run(rho_bits=64, tamper=cheat)
+    assert "error" not in out
+    assert out["complaints"] == [(1, 3), (5, 3)]
+    assert np.asarray(out["qualified"]).tolist() == [
+        True, True, False, True, True, True, True, True,
+    ]
+    # final shares exclude dealer 3: recompute expected aggregate
+    shares = np.asarray(out["shares"])
+    for i in range(8):
+        expect = sum(
+            fh.decode_int(fs, shares[j, i]) for j in range(8) if j != 2
+        ) % fs.modulus
+        got = fh.decode_int(fs, np.asarray(out["final_shares"])[i])
+        assert got == expect
+    # master key = sum of qualified dealers' A_0
+    from dkg_tpu.groups import device as gd, host as gh
+
+    g = gh.RISTRETTO255
+    cs = c.cfg.cs
+    a0 = gd.to_host(cs, np.asarray(out["bare"])[:, 0])
+    acc = g.identity()
+    for j in range(8):
+        if j != 2:
+            acc = g.add(acc, a0[j])
+    assert g.eq(gd.to_host(cs, np.asarray(out["master"])[None])[0], acc)
+
+
+def test_run_aborts_when_cheaters_exceed_threshold():
+    c = ce.BatchedCeremony("ristretto255", 8, 2, b"abort", random.Random(6))
+    fs = c.cfg.cs.scalar
+
+    def cheat(a, e, s, r):
+        bad = np.asarray(s).copy()
+        for j in (0, 3, 6):  # 3 cheating dealers > t=2
+            bad[j, 1] = fh.encode(fs, (fh.decode_int(fs, bad[j, 1]) + 1) % fs.modulus)
+        return a, e, jnp.asarray(bad), r
+
+    out = c.run(rho_bits=64, tamper=cheat)
+    from dkg_tpu.dkg.errors import DkgErrorKind
+
+    assert out["error"].kind == DkgErrorKind.MISBEHAVIOUR_HIGHER_THRESHOLD
+    assert np.asarray(out["qualified"]).sum() == 5

@@ -143,3 +143,37 @@ def test_correct_decryption_key_proof(g):
 
     fake = SymmetricKey(g.scalar_mul(g.random_scalar(RNG), g.generator()))
     assert not proof.verify(g, c, kp.pk, fake)
+
+
+def test_ciphertext_operator_ergonomics():
+    """a + b, a - b, k * a mirror the reference's operator macros over
+    Ciphertext (reference: macros.rs:3-43, elgamal.rs:219-283)."""
+    import random as _r
+
+    from dkg_tpu.crypto.elgamal import Keypair, decrypt_point, encrypt
+
+    rng = _r.Random(0x0D5)
+    g = gh.RISTRETTO255
+    kp = Keypair.generate(g, rng)
+    a = encrypt(g, kp.pk, 11, rng)
+    b = encrypt(g, kp.pk, 31, rng)
+    fs = g.scalar_field
+
+    def dec(c):
+        return decrypt_point(g, kp.sk, c)
+
+    assert g.eq(dec(a + b), g.scalar_mul(42, g.generator()))
+    assert g.eq(dec(b - a), g.scalar_mul(20, g.generator()))
+    assert g.eq(dec(a * 3), g.scalar_mul(33, g.generator()))
+    assert g.eq(dec(3 * a), g.scalar_mul(33, g.generator()))
+    assert g.eq(dec((a + b) * 2 - a), g.scalar_mul(73, g.generator()))
+    # group-free values refuse the operator form with a clear error
+    from dkg_tpu.crypto.elgamal import Ciphertext
+
+    bare = Ciphertext(a.e1, a.e2)
+    assert bare == a  # equality ignores the carried group
+    try:
+        bare + a
+        assert False, "expected TypeError"
+    except TypeError:
+        pass

@@ -1,0 +1,91 @@
+"""Device Merkle-tree transcript hash (crypto/device_hash.py).
+
+Three layers: (1) the BLAKE2s compression function is validated against
+CPython's hashlib.blake2s on single-block messages (same IV/SIGMA/G —
+the only difference in a standard single-block hash is the parameter
+word, which we set to the standard 0x01010020); (2) the jnp tree equals
+the pure-Python twin on assorted shapes; (3) the ceremony-level device
+transcript digest binds every limb, like the host digest it replaces on
+the hot path.
+"""
+
+import hashlib
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dkg_tpu.crypto import device_hash as dh
+
+RNG = random.Random(0xD167)
+
+
+def _std_single_block_hash_py(data: bytes) -> bytes:
+    """Standard BLAKE2s-256 of <=64 bytes via our compression function."""
+    assert len(data) <= 64
+    h = list(dh.IV)
+    h[0] ^= 0x01010020  # digest_length=32, fanout=1, depth=1
+    block = data + b"\x00" * (64 - len(data))
+    words = [int.from_bytes(block[i * 4 : (i + 1) * 4], "little") for i in range(16)]
+    out = dh._compress_py(h, words, len(data), dh.MASK32)
+    return b"".join(w.to_bytes(4, "little") for w in out)
+
+
+@pytest.mark.parametrize("size", [0, 1, 3, 31, 32, 63, 64])
+def test_compression_matches_hashlib_blake2s(size):
+    data = bytes(RNG.randrange(256) for _ in range(size))
+    assert _std_single_block_hash_py(data) == hashlib.blake2s(data).digest()
+
+
+@pytest.mark.parametrize("words", [1, 15, 16, 17, 64, 100, 1024])
+def test_device_tree_matches_python_twin(words):
+    vals = [RNG.randrange(1 << 32) for _ in range(words)]
+    dev = np.asarray(dh.tree_digest(jnp.asarray(vals, jnp.uint32), domain=7))
+    ref = dh.tree_digest_host(vals, domain=7)
+    assert [int(x) for x in dev] == ref
+
+
+def test_row_digests_are_independent_rows():
+    rows = np.asarray(
+        [[RNG.randrange(1 << 32) for _ in range(40)] for _ in range(5)], np.uint32
+    )
+    got = np.asarray(dh.row_digests(jnp.asarray(rows), domain=3))
+    for i in range(5):
+        solo = np.asarray(dh.tree_digest(jnp.asarray(rows[i]), domain=3))
+        assert (got[i] == solo).all()
+
+
+def test_domain_and_length_bind():
+    vals = [7] * 32
+    a = dh.tree_digest_host(vals, domain=1)
+    b = dh.tree_digest_host(vals, domain=2)
+    assert a != b
+    # trailing zeros change the word count, hence the digest
+    c = dh.tree_digest_host(vals + [0], domain=1)
+    assert a != c
+    # leaf vs interior domains differ: a 16-word input's digest is not
+    # the digest of its own leaf hash reinterpreted
+    leaf_only = dh.tree_digest_host(vals[:16], domain=1)
+    assert leaf_only != dh.tree_digest_host(
+        [int(x) for x in np.asarray(dh.tree_digest_host(vals[:16], domain=1))],
+        domain=1,
+    )
+
+
+def test_ceremony_device_digest_binds_every_tensor():
+    import jax.numpy as jnp
+    import random as _random
+
+    from dkg_tpu.dkg import ceremony as ce
+
+    c = ce.BatchedCeremony("ristretto255", 4, 1, b"dh", _random.Random(3))
+    a, e, s, r = ce.deal(c.cfg, c.coeffs_a, c.coeffs_b, c.g_table, c.h_table)
+    base = ce.transcript_digest_device(c.cfg, a, e, s, r)
+    for k, t in enumerate((a, e, s, r)):
+        flipped = np.asarray(t).copy()
+        flipped.flat[k * 3 + 1] ^= 1
+        args = [a, e, s, r]
+        args[k] = jnp.asarray(flipped)
+        assert ce.transcript_digest_device(c.cfg, *args) != base, k

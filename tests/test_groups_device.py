@@ -16,6 +16,8 @@ from dkg_tpu.fields import host as fh
 from dkg_tpu.groups import device as gd
 from dkg_tpu.groups import host as gh
 
+pytestmark = pytest.mark.slow  # compile-heavy: nightly/device tier
+
 RNG = random.Random(0xDE71CE)
 
 CURVES = [gd.RISTRETTO255, gd.SECP256K1, gd.BLS12_381_G1]

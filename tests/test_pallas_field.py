@@ -11,6 +11,7 @@ import os
 import random
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -19,6 +20,8 @@ from dkg_tpu.fields import device as fd
 from dkg_tpu.fields import host as fh
 from dkg_tpu.fields.spec import ALL_FIELDS
 from dkg_tpu.ops import pallas_field as pf
+
+pytestmark = pytest.mark.slow  # compile-heavy: nightly/device tier
 
 RNG = random.Random(0xA11A5)
 

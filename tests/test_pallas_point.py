@@ -31,6 +31,8 @@ from dkg_tpu.groups import device as gd
 from dkg_tpu.groups import host as gh
 from dkg_tpu.ops import pallas_point as pp
 
+pytestmark = pytest.mark.slow  # compile-heavy: nightly/device tier
+
 RNG = random.Random(0xEDED)
 
 ON_TPU = jax.default_backend() == "tpu"

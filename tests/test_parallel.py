@@ -54,10 +54,11 @@ def test_sharded_deal_matches_single_device_transcript():
     )
     np.testing.assert_array_equal(np.asarray(e_all), np.asarray(e))
     np.testing.assert_array_equal(np.asarray(s_sh), np.asarray(s))
-    # the shard-folded digest equals the flat one bit-for-bit
+    # the shard-folded digest equals the flat canonical (device) digest
+    # bit-for-bit — sharded and single-chip engines derive the same rho
     assert ce.sharded_transcript_digest(
         c.cfg, a_all, e_all, s_sh, r_sh
-    ) == ce.transcript_digest(c.cfg, a, e, s, r)
+    ) == ce.transcript_digest_device(c.cfg, a, e, s, r)
 
 
 def test_mesh_shapes():
