@@ -110,6 +110,12 @@ def _field_dot(fs, weights: jax.Array, values: jax.Array) -> jax.Array:
 
     weights (m, L), values (m, ..., L) -> (..., L).
     """
+    from ..fields import matmul as fmm
+
+    if (fmm.mxu_matmul_active() and values.ndim == 3
+            and weights.shape[0] <= fmm.MAX_K):
+        # one-row modular matmul on the MXU (contraction over dealers)
+        return fmm.matmul_mod(fs, weights[None], jnp.swapaxes(values, 0, 1))[0]
     prods = fd.mul(fs, weights.reshape((weights.shape[0],) + (1,) * (values.ndim - 2) + (weights.shape[-1],)), values)
 
     def step(acc, v):

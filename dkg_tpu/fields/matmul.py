@@ -1,0 +1,211 @@
+"""Modular matrix multiply on the MXU: limb matmuls in int8 systolic passes.
+
+The ceremony's biggest FIELD workload is share evaluation — the n x n
+share matrix s[d, i] = f_d(x_i) (reference hot loop #2,
+committee.rs:163-186).  Written as a Vandermonde product
+
+    s = C @ V^T  (mod p),   C[d, l] = coeff,  V[i, l] = x_i^l,
+
+it is a modular matmul: contraction over t+1 coefficients for every
+(dealer, recipient) pair.  The Horner formulation (poly.device.eval_many)
+runs this as t+1 sequential full-width field multiplies on the VPU; this
+module instead runs the whole contraction as int8 matmuls on the MXU —
+the TPU's systolic array — and defers ALL modular reduction to one
+Barrett pass per output element:
+
+1. split every 16-bit limb into two 8-bit halves (base-256 digits);
+2. zero-point shift to int8 (the MXU's native dtype) and dot over the
+   contraction axis with int32 accumulation — exact integer arithmetic:
+   |sum| <= K * 128^2, so K up to 2^17 never wraps int32;
+3. undo the zero-point with rank-1 corrections (row/column digit sums);
+4. antidiagonal-add the digit products into base-256 columns of the
+   un-reduced integer sum_k a_k * b_k  (same schoolbook collapse as
+   fields.device.mul_wide, one limb axis now paid by the MXU);
+5. carry-normalize and fold the b^(2L)-and-up tail back with the
+   precomputed constant 2^(32L) mod p, then one Barrett reduction.
+
+Step 2 is where >99% of the multiplies happen, so the VPU work left per
+output element is O(L) instead of O(K*L).
+
+Used by poly.device.eval_many (share dealing) and dkg.ceremony._field_dot
+(the scalar side of RLC batch verification) when ``mxu_matmul_active()``;
+bit-exact against the Horner/scan paths by construction (tests:
+tests/test_field_matmul.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from . import device as fd
+from .spec import FieldSpec, int_to_limbs
+
+# Contraction chunk: keeps every base-256 accumulator column strictly
+# inside uint32 — worst case 2L terms/column * 255^2 * KCHUNK
+# = 32 * 65025 * 1024 ~= 2.13e9 < 2^32 - 2^24 (normalize headroom).
+KCHUNK = 1024
+
+# Output blocking: bound the live (M, NB, 4L-1) uint32 column accumulator
+# (plus one (M, NB*2L) int32 dot result) to a few hundred MB.
+BLOCK_BYTES = 256 << 20
+
+# Largest supported contraction: the 4L+2-byte accumulator holds values
+# < 2^(32L+16) >= K * p^2 and the _reduce_block fold proof assumes
+# K <= 2^14; dispatch sites fall back to the scan paths beyond this.
+MAX_K = 16384
+
+
+def mxu_matmul_active() -> bool:
+    """Whether modular matmuls route to the MXU int8 formulation.
+
+    DKG_TPU_MXU=1/0 forces; default follows the backend (ON for TPU —
+    the int8 dot is exact on every backend, the MXU is just where it
+    pays).  Resolved lazily at trace time, like fused_kernels_active().
+    """
+    env = os.environ.get("DKG_TPU_MXU")
+    if env == "1":
+        return True
+    if env == "0":
+        return False
+    return fd._on_tpu()
+
+
+@functools.lru_cache(maxsize=None)
+def _fold_const(fs: FieldSpec) -> np.ndarray:
+    """2^(32*L) mod p as L limbs: folds the b^(2L) tail of an over-wide
+    accumulator back into Barrett range."""
+    return np.asarray(int_to_limbs(pow(2, 32 * fs.limbs, fs.modulus), fs.limbs),
+                      np.uint32)
+
+
+def _normalize_base256(cols: jax.Array, out_len: int) -> jax.Array:
+    """Carry-propagate uint32 base-256 columns into ``out_len`` 8-bit limbs."""
+    cols = jnp.asarray(cols, jnp.uint32)
+    k = cols.shape[-1]
+    if k < out_len:
+        cols = jnp.pad(cols, [(0, 0)] * (cols.ndim - 1) + [(0, out_len - k)])
+    xs = jnp.moveaxis(cols[..., :out_len], -1, 0)
+
+    def step(carry, col):
+        s = col + carry
+        return s >> 8, s & 0xFF
+
+    _, limbs = lax.scan(step, jnp.zeros(cols.shape[:-1], jnp.uint32), xs)
+    return jnp.moveaxis(limbs, 0, -1)
+
+
+def _to_digits(a: jax.Array) -> jax.Array:
+    """(..., L) 16-bit limbs -> (..., 2L) base-256 digits, little-endian."""
+    lo = a & 0xFF
+    hi = (a >> 8) & 0xFF
+    return jnp.stack([lo, hi], axis=-1).reshape(a.shape[:-1] + (2 * a.shape[-1],))
+
+
+def _block_cols(fs: FieldSpec, a_dig: jax.Array, b_dig: jax.Array) -> jax.Array:
+    """Base-256 columns of sum_k a[m,k]*b[n,k] for one output block.
+
+    a_dig (M, K, D), b_dig (NB, K, D) digits -> (M, NB, 4L+2) 8-bit
+    limbs of the exact (un-reduced) integer sums.
+    """
+    m, k, d = a_dig.shape
+    nb = b_dig.shape[0]
+    l = d // 2
+    w = 2 * d - 1
+    nlimb8 = 4 * l + 2  # value < K * p^2 < 2^(32L + 14)
+    acc8 = None
+    for k0 in range(0, k, KCHUNK):
+        a_c = a_dig[:, k0 : k0 + KCHUNK]
+        b_c = b_dig[:, k0 : k0 + KCHUNK]
+        kc = a_c.shape[1]
+        a_s = (a_c.astype(jnp.int32) - 128).astype(jnp.int8)
+        b_s = (b_c.astype(jnp.int32) - 128).astype(jnp.int8)
+        # rank-1 zero-point corrections: sa[m,u] = sum_k a_s, sb[n,v]
+        sa = jnp.sum(a_c.astype(jnp.int32), axis=1) - 128 * kc  # (M, D)
+        sb = jnp.sum(b_c.astype(jnp.int32), axis=1) - 128 * kc  # (NB, D)
+        b_flat = jnp.moveaxis(b_s, 1, 0).reshape(kc, nb * d)  # (K, NB*D)
+        corr_b = (128 * sb.reshape(nb * d) + 16384 * kc)[None, :]
+        cols = None
+        for u in range(d):
+            g = lax.dot_general(
+                a_s[:, :, u], b_flat,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )  # (M, NB*D) exact shifted products
+            g = (g + 128 * sa[:, u][:, None] + corr_b).astype(jnp.uint32)
+            row = jnp.pad(
+                g.reshape(m, nb, d), [(0, 0), (0, 0), (u, w - d - u)]
+            )
+            cols = row if cols is None else cols + row
+        part = _normalize_base256(cols, nlimb8)
+        acc8 = part if acc8 is None else acc8 + part
+    # chunk partials are 8-bit limbs (< 256 each); one more carry pass
+    return _normalize_base256(acc8, nlimb8) if k > KCHUNK else acc8
+
+
+def _reduce_block(fs: FieldSpec, total8: jax.Array) -> jax.Array:
+    """(..., 4L+2) 8-bit limbs -> (..., L) canonical field elements."""
+    l = fs.limbs
+    y = total8[..., 0::2] + (total8[..., 1::2] << 8)  # (..., 2L+1) 16-bit
+    c = jnp.asarray(_fold_const(fs))
+    # Two folds of the top limb with c = 2^(32L) mod p:
+    #   y0 < 2^(32L+14)  ->  y1 = lo + top*c < b^(2L) + 2^16 * p
+    #   ->  y2 < b^(2L)  (if y1's top limb is 1, its low part is < 2^16*p,
+    #       so y2 < 2^16*p + p < b^(2L)).  Top limb provably 0 after.
+    for _ in range(2):
+        hi = y[..., 2 * l :]
+        folded = fd.mul_wide(hi, jnp.broadcast_to(c, hi.shape[:-1] + (l,)))
+        cols = jnp.pad(
+            y[..., : 2 * l].astype(jnp.uint32),
+            [(0, 0)] * (y.ndim - 1) + [(0, 1)],
+        )
+        fw = folded.shape[-1]
+        cols = cols + jnp.pad(
+            folded[..., : 2 * l + 1],
+            [(0, 0)] * (y.ndim - 1) + [(0, max(0, 2 * l + 1 - fw))],
+        )
+        y = fd.normalize(cols, 2 * l + 1)
+    return fd.barrett_reduce(fs, y[..., : 2 * l])
+
+
+def matmul_mod(fs: FieldSpec, a: jax.Array, b: jax.Array) -> jax.Array:
+    """sum_k a[m, k] * b[n, k] mod p on the MXU.
+
+    a (M, K, L), b (N, K, L) 16-bit-limb field elements ->
+    (M, N, L) canonical (< p) results, bit-exact vs the scan/Horner
+    formulations.  K <= 2^14 (the binding bound: the 4L+2-byte
+    accumulator holds values < 2^(32L+16) >= K * p^2, and the
+    _reduce_block fold proof assumes the same; covers n=16384, the
+    largest BASELINE config).  The N axis is processed in blocks sized
+    so the per-block accumulators stay a few hundred MB (lax.map: one
+    traced body regardless of block count).
+    """
+    m, k, l = a.shape
+    if k > MAX_K:
+        raise ValueError(
+            f"matmul_mod contraction K={k} exceeds the 2^14 accumulator "
+            "bound; chunk the contraction and add partial sums mod p"
+        )
+    n = b.shape[0]
+    a_dig = _to_digits(jnp.asarray(a, jnp.uint32))
+    b_dig = _to_digits(jnp.asarray(b, jnp.uint32))
+
+    per_col = m * (4 * l - 1) * 4 + m * 2 * l * 4  # cols + dot bytes per n
+    nb = max(1, min(n, BLOCK_BYTES // per_col))
+
+    def block(b_blk):
+        return _reduce_block(fs, _block_cols(fs, a_dig, b_blk))
+
+    if nb >= n:
+        return block(b_dig)
+    nblocks = -(-n // nb)
+    pad = nblocks * nb - n
+    if pad:
+        b_dig = jnp.pad(b_dig, [(0, pad), (0, 0), (0, 0)])
+    out = lax.map(block, b_dig.reshape(nblocks, nb, k, 2 * l))
+    return jnp.moveaxis(out, 0, 1).reshape(m, nblocks * nb, l)[:, :n]

@@ -31,6 +31,17 @@ def eval_many(fs: FieldSpec, coeffs: jax.Array, xs: jax.Array) -> jax.Array:
     xs:     (..., N, L) — N evaluation points.
     returns (..., N, L) — values; batch axes broadcast.
     """
+    from ..fields import matmul as fmm
+
+    if (fmm.mxu_matmul_active() and coeffs.ndim == 3 and xs.ndim == 2
+            and coeffs.shape[-2] <= fmm.MAX_K):
+        # Vandermonde form on the MXU: one int8 systolic contraction over
+        # the T coefficients instead of T sequential VPU field multiplies.
+        # V[i, l] = x_i^l costs T muls over (N, L) — negligible vs the
+        # (D, T) x (T, N) product it feeds.
+        vand = powers(fs, xs, coeffs.shape[-2])  # (N, T, L)
+        return fmm.matmul_mod(fs, coeffs, vand)
+
     # scan MSB-first over coefficients: acc = acc*x + c_k
     cs_rev = jnp.moveaxis(coeffs, -2, 0)[::-1]  # (T, ..., L)
     batch = jnp.broadcast_shapes(coeffs.shape[:-2], xs.shape[:-2])

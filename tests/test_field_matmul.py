@@ -1,0 +1,117 @@
+"""MXU modular matmul (fields/matmul.py) vs the host big-int oracle.
+
+The int8-digit formulation must be bit-exact against plain Python
+modular arithmetic and against the Horner/scan paths it replaces
+(poly.device.eval_many, dkg.ceremony._field_dot).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dkg_tpu.fields import device as fd
+from dkg_tpu.fields import host as fh
+from dkg_tpu.fields import matmul as fmm
+from dkg_tpu.fields.spec import ALL_FIELDS
+
+RNG = random.Random(0xF33D)
+
+
+def _rand_mat(fs, rows, cols):
+    ints = [[fs.rand_int(RNG) for _ in range(cols)] for _ in range(rows)]
+    return ints, jnp.asarray(fh.encode(fs, ints))
+
+
+@pytest.mark.parametrize(
+    "field", ["ed25519_scalar", "secp256k1_scalar", "bls12_381_scalar",
+              "bls12_381_base"]
+)
+def test_matmul_mod_matches_oracle(field):
+    fs = ALL_FIELDS[field]
+    m, k, n = 3, 5, 4
+    a_int, a = _rand_mat(fs, m, k)
+    b_int, b = _rand_mat(fs, n, k)
+    out = np.asarray(fmm.matmul_mod(fs, a, b))
+    for i in range(m):
+        for j in range(n):
+            want = sum(a_int[i][l] * b_int[j][l] for l in range(k)) % fs.modulus
+            assert fh.decode_int(fs, out[i, j]) == want, (i, j)
+
+
+def test_matmul_mod_contraction_chunking():
+    """K > KCHUNK exercises the chunk accumulation + extra carry pass."""
+    fs = ALL_FIELDS["secp256k1_scalar"]
+    k = fmm.KCHUNK + 7
+    a_int, a = _rand_mat(fs, 2, k)
+    b_int, b = _rand_mat(fs, 2, k)
+    out = np.asarray(fmm.matmul_mod(fs, a, b))
+    for i in range(2):
+        for j in range(2):
+            want = sum(a_int[i][l] * b_int[j][l] for l in range(k)) % fs.modulus
+            assert fh.decode_int(fs, out[i, j]) == want
+
+
+def test_matmul_mod_extreme_values():
+    """All-(p-1) inputs maximize every accumulator column — the overflow
+    audit's worst case must still carry correctly."""
+    fs = ALL_FIELDS["secp256k1_scalar"]
+    k = 9
+    top = fs.modulus - 1
+    a = jnp.asarray(fh.encode(fs, [[top] * k]))
+    out = np.asarray(fmm.matmul_mod(fs, a, a))
+    assert fh.decode_int(fs, out[0, 0]) == (k * top * top) % fs.modulus
+
+
+def test_eval_many_mxu_matches_horner(monkeypatch):
+    fs = ALL_FIELDS["ed25519_scalar"]
+    from dkg_tpu.poly import device as pdev
+
+    coeffs_int = [[fs.rand_int(RNG) for _ in range(4)] for _ in range(6)]
+    coeffs = jnp.asarray(fh.encode(fs, coeffs_int))
+    xs = jnp.zeros((5, fs.limbs), jnp.uint32).at[:, 0].set(
+        jnp.arange(1, 6, dtype=jnp.uint32)
+    )
+    monkeypatch.setenv("DKG_TPU_MXU", "0")
+    ref = np.asarray(pdev.eval_many(fs, coeffs, xs))
+    monkeypatch.setenv("DKG_TPU_MXU", "1")
+    got = np.asarray(pdev.eval_many(fs, coeffs, xs))
+    assert np.array_equal(ref, got)
+    # and against the direct formula
+    for d in range(6):
+        for i in range(5):
+            want = sum(
+                c * pow(i + 1, l, fs.modulus) for l, c in enumerate(coeffs_int[d])
+            ) % fs.modulus
+            assert fh.decode_int(fs, got[d, i]) == want
+
+
+def test_field_dot_mxu_matches_scan(monkeypatch):
+    from dkg_tpu.dkg import ceremony as ce
+
+    fs = ALL_FIELDS["secp256k1_scalar"]
+    _, w = _rand_mat(fs, 7, 1)
+    weights = w[:, 0]
+    vals_int, _ = _rand_mat(fs, 7, 3)
+    values = jnp.asarray(fh.encode(fs, vals_int))[:, :, None, :].reshape(7, 3, -1)
+    monkeypatch.setenv("DKG_TPU_MXU", "0")
+    ref = np.asarray(ce._field_dot(fs, weights, values))
+    monkeypatch.setenv("DKG_TPU_MXU", "1")
+    got = np.asarray(ce._field_dot(fs, weights, values))
+    assert np.array_equal(ref, got)
+
+
+def test_matmul_mod_blocking(monkeypatch):
+    """Force a tiny block size so the lax.map path (pad + reassemble)
+    is exercised."""
+    fs = ALL_FIELDS["ed25519_scalar"]
+    monkeypatch.setattr(fmm, "BLOCK_BYTES", 1)  # nb=1 -> N blocks + padding
+    a_int, a = _rand_mat(fs, 2, 3)
+    b_int, b = _rand_mat(fs, 5, 3)
+    out = np.asarray(fmm.matmul_mod(fs, a, b))
+    for i in range(2):
+        for j in range(5):
+            want = sum(a_int[i][l] * b_int[j][l] for l in range(3)) % fs.modulus
+            assert fh.decode_int(fs, out[i, j]) == want
