@@ -247,9 +247,25 @@ def open_pair(
     both payloads; the legacy two-KEM layout falls back to two.
     """
     kem1 = recover_symmetric_key(group, sk, share_ct)
-    pt1 = hybrid_decrypt_with_key(group, kem1, share_ct, PERSON_SHARE)
     if group.eq(share_ct.e1, rand_ct.e1):
-        pt2 = hybrid_decrypt_with_key(group, kem1, rand_ct, PERSON_RAND)
+        kem2 = kem1
     else:
-        pt2 = hybrid_decrypt(group, sk, rand_ct, PERSON_SHARE)
+        kem2 = recover_symmetric_key(group, sk, rand_ct)
+    return open_pair_with_kems(group, kem1, kem2, share_ct, rand_ct)
+
+
+def open_pair_with_kems(
+    group: HostGroup,
+    kem1: SymmetricKey,
+    kem2: SymmetricKey,
+    share_ct: HybridCiphertext,
+    rand_ct: HybridCiphertext,
+) -> tuple[bytes, bytes]:
+    """DEM half of :func:`open_pair`, with the KEM exponentiations
+    (sk*e1 per distinct e1) supplied by the caller — the batched wire
+    path (dkg.committee_batch) computes those on device in bulk."""
+    pt1 = hybrid_decrypt_with_key(group, kem1, share_ct, PERSON_SHARE)
+    pt2 = hybrid_decrypt_with_key(
+        group, kem2, rand_ct, rand_person(group, share_ct, rand_ct)
+    )
     return pt1, pt2

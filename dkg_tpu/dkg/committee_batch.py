@@ -25,13 +25,24 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
+from ..crypto.elgamal import SymmetricKey, open_pair_with_kems
 from ..fields import host as fh
 from ..groups import device as gd
-from .committee import DkgPhase1, Environment, _State
+from .committee import DkgPhase1, DkgPhase2, Environment, FetchedPhase1, _State
 from .hybrid_batch import broadcasts_from_batch, kem_batch, seal_shares
-from .broadcast import BroadcastPhase1
+from .broadcast import (
+    BroadcastPhase1,
+    BroadcastPhase2,
+    MisbehavingPartiesRound1,
+    ProofOfMisbehaviour,
+)
 from .ceremony import CeremonyConfig, deal
-from .procedure_keys import MemberCommunicationKey, sort_committee
+from .errors import DkgError, DkgErrorKind
+from .procedure_keys import (
+    MemberCommunicationKey,
+    decode_scalar_pair,
+    sort_committee,
+)
 
 
 def batched_dealing(
@@ -106,3 +117,151 @@ def batched_dealing(
         )
         out.append((DkgPhase1(state), broadcasts[d]))
     return out
+
+
+def batched_share_verification(
+    phase1s: list[DkgPhase1],
+    fetched: list[FetchedPhase1],
+    rng,
+) -> list[tuple["DkgPhase2 | DkgError", BroadcastPhase2 | None]]:
+    """Round-2 share verification for many co-located parties at once.
+
+    Semantics are EXACTLY per-party ``DkgPhase1.proceed(fetched, rng)``
+    (reference hot loop committee.rs:273-317) — same state mutations,
+    complaints (sender order preserved), error returns, and threshold
+    abort — but the two per-pair device costs run as bulk kernels over
+    all (recipient, dealer) pairs:
+
+    * KEM recovery sk_i * e1 (one per distinct pair e1): one batched
+      ``scalar_mul`` call instead of n*(n-1) host ladder walks;
+    * the commitment check g*s + h*s' == sum_l x_i^l E_{j,l}: two
+      fixed-base batch mults + one batched point-Horner
+      (committee.rs:292-296 as one wide op).
+
+    ChaCha DEM decode, scalar decoding, and the (rare) complaint
+    evidence generation stay host-side.  ``fetched`` is the shared
+    broadcast-channel view every local party consumes — the in-process
+    simulation seam (reference: committee.rs:1337-1338).
+    """
+    if not phase1s:
+        return []
+    sts = [p._state for p in phase1s]
+    env, group = sts[0].env, sts[0].group
+    cs = gd.ALL_CURVES[group.name]
+    fs = group.scalar_field
+    sender_order = [f.sender_index for f in fetched]
+
+    # --- stage 1: host triage in fetched order (dropouts, misaddressed
+    # data), collecting one KEM exponentiation per distinct pair e1
+    kem_sks: list[int] = []
+    kem_pts: list[tuple] = []
+    jobs: list[tuple[int, int, object, int, int]] = []
+    errors: list[DkgError | None] = [None] * len(sts)
+    for i, st in enumerate(sts):
+        for f in fetched:
+            j = f.sender_index
+            if j == st.index:
+                continue
+            if f.broadcast is None:
+                st.disqualify(j)  # silent dropout (committee.rs:332-337)
+                continue
+            mine = f.broadcast.shares_for(st.index)
+            if mine is None or mine.recipient_index != st.index:
+                errors[i] = DkgError(DkgErrorKind.FETCHED_INVALID_DATA, index=j)
+                break
+            k1 = len(kem_sks)
+            kem_sks.append(st.comm_key.sk)
+            kem_pts.append(mine.share_ct.e1)
+            if group.eq(mine.share_ct.e1, mine.randomness_ct.e1):
+                k2 = k1  # canonical sealed-pair layout: one KEM for both
+            else:
+                k2 = len(kem_sks)
+                kem_sks.append(st.comm_key.sk)
+                kem_pts.append(mine.randomness_ct.e1)
+            jobs.append((i, j, mine, k1, k2))
+
+    # --- stage 2: all KEM exponentiations as one device batch
+    kem_host: list = []
+    if kem_sks:
+        kem_dev = gd.scalar_mul(
+            cs, jnp.asarray(fh.encode(fs, kem_sks)), gd.from_host(cs, kem_pts)
+        )
+        kem_host = gd.to_host(cs, np.asarray(kem_dev))
+
+    # --- stage 3: host DEM decode; failures become complaints, decodable
+    # pairs queue for the batched commitment check
+    complaint_at: dict[tuple[int, int], MisbehavingPartiesRound1] = {}
+    share_jobs: list[tuple[int, int, object, int, int]] = []
+    for i, j, mine, k1, k2 in jobs:
+        st = sts[i]
+        pt1, pt2 = open_pair_with_kems(
+            group,
+            SymmetricKey(kem_host[k1]),
+            SymmetricKey(kem_host[k2]),
+            mine.share_ct,
+            mine.randomness_ct,
+        )
+        (s, r), kind = decode_scalar_pair(group, pt1, pt2)
+        if s is None or r is None:
+            st.disqualify(j)  # committee.rs:318-331
+            complaint_at[(i, j)] = MisbehavingPartiesRound1(
+                j,
+                kind or DkgErrorKind.SCALAR_OUT_OF_BOUNDS,
+                ProofOfMisbehaviour.generate(group, mine, st.comm_key, rng),
+            )
+            continue
+        share_jobs.append((i, j, mine, s, r))
+
+    # --- stage 4: every commitment check as one device batch (the
+    # shared implementation complaint adjudication also uses; dealer
+    # commitments converted host->device once per dealer, not per pair)
+    if share_jobs:
+        from .complaints_batch import check_randomized_shares_limbs
+
+        s_limbs = jnp.asarray(fh.encode(fs, [x[3] for x in share_jobs]))
+        r_limbs = jnp.asarray(fh.encode(fs, [x[4] for x in share_jobs]))
+        by_sender = {f.sender_index: f.broadcast for f in fetched}
+        coeff_np: dict[int, np.ndarray] = {}
+        for _, j, *_ in share_jobs:
+            if j not in coeff_np:
+                coeff_np[j] = np.asarray(
+                    gd.from_host(cs, list(by_sender[j].committed_coefficients))
+                )
+        cpts = jnp.asarray(np.stack([coeff_np[j] for _, j, *_ in share_jobs]))
+        idx = jnp.asarray([sts[i].index for i, *_ in share_jobs], dtype=jnp.uint32)
+        nbits = max(2, int(env.nr_members).bit_length())
+        ok = check_randomized_shares_limbs(
+            group, cs, env.commitment_key, idx, s_limbs, r_limbs, cpts, nbits
+        )
+        for (i, j, mine, s, r), good in zip(share_jobs, ok):
+            st = sts[i]
+            if bool(good):
+                st.received_shares[j] = (s, r)
+                st.randomized_coeffs[j] = tuple(
+                    by_sender[j].committed_coefficients
+                )
+            else:
+                st.disqualify(j)  # committee.rs:305-317
+                complaint_at[(i, j)] = MisbehavingPartiesRound1(
+                    j,
+                    DkgErrorKind.SHARE_VALIDITY_FAILED,
+                    ProofOfMisbehaviour.generate(group, mine, st.comm_key, rng),
+                )
+
+    # --- stage 5: per-party assembly, complaints in fetched sender order
+    results: list[tuple[DkgPhase2 | DkgError, BroadcastPhase2 | None]] = []
+    for i, st in enumerate(sts):
+        if errors[i] is not None:
+            results.append((errors[i], None))
+            continue
+        comps = tuple(
+            complaint_at[(i, j)] for j in sender_order if (i, j) in complaint_at
+        )
+        broadcast = BroadcastPhase2(comps) if comps else None
+        if len(comps) > env.threshold:
+            results.append(
+                (DkgError(DkgErrorKind.MISBEHAVIOUR_HIGHER_THRESHOLD), broadcast)
+            )
+        else:
+            results.append((DkgPhase2(st), broadcast))
+    return results

@@ -49,17 +49,38 @@ def check_randomized_shares_batch(
     fs = group.scalar_field
     k = len(indices)
     tp1 = len(coeffs_list[0])
-    # lhs = g*s + h*s'
-    g_tab = gd.fixed_base_table(cs, group.generator())
-    h_tab = gd.fixed_base_table(cs, ck.h)
     s_limbs = jnp.asarray(fh.encode(fs, shares))
     r_limbs = jnp.asarray(fh.encode(fs, rands))
-    lhs = gd.add(cs, gd.fixed_base_mul(cs, g_tab, s_limbs), gd.fixed_base_mul(cs, h_tab, r_limbs))
-    # rhs: Horner over the coefficient points at the accuser indices
     flat_coeffs = [c for coeffs in coeffs_list for c in coeffs]
     cpts = gd.from_host(cs, flat_coeffs).reshape(k, tp1, cs.ncoords, cs.field.limbs)
     idx = jnp.asarray(indices, dtype=jnp.uint32)
     nbits = max(2, int(max(indices)).bit_length())
+    return check_randomized_shares_limbs(
+        group, cs, ck, idx, s_limbs, r_limbs, cpts, nbits
+    )
+
+
+def check_randomized_shares_limbs(
+    group: gh.HostGroup,
+    cs,
+    ck: CommitmentKey,
+    idx: jnp.ndarray,  # (k,) uint32 recipient indices
+    s_limbs: jnp.ndarray,  # (k, L)
+    r_limbs: jnp.ndarray,  # (k, L)
+    cpts: jnp.ndarray,  # (k, t+1, C, L) dealer commitment points
+    nbits: int,
+) -> np.ndarray:
+    """Device core of the batched check, on pre-encoded limb arrays —
+    THE single implementation of g*s + h*s' == sum_l idx^l E_l shared by
+    complaint adjudication and the batched round-2
+    (committee_batch.batched_share_verification)."""
+    g_tab = gd.fixed_base_table(cs, group.generator())
+    h_tab = gd.fixed_base_table(cs, ck.h)
+    lhs = gd.add(
+        cs,
+        gd.fixed_base_mul(cs, g_tab, s_limbs),
+        gd.fixed_base_mul(cs, h_tab, r_limbs),
+    )
     rhs = gd.eval_point_poly(cs, cpts, idx, nbits)
     return np.asarray(gd.eq(cs, lhs, rhs))
 

@@ -97,10 +97,17 @@ def decrypt_shares_detailed(
     errors.rs:32-35, broadcast.rs:260-267) vs SCALAR_OUT_OF_BOUNDS for
     well-formed bytes encoding a value >= the group order (reference:
     errors.rs:15-18).  Returns ((s|None, r|None), kind|None)."""
+    pt1, pt2 = open_pair(group, sk.sk, share_ct, randomness_ct)
+    return decode_scalar_pair(group, pt1, pt2)
+
+
+def decode_scalar_pair(group: HostGroup, pt1: bytes, pt2: bytes):
+    """Byte->scalar decoding + failure classification shared by the
+    serial and batched decryption paths.  Returns
+    ((s|None, r|None), kind|None)."""
     from .errors import DkgErrorKind
 
     fs = group.scalar_field
-    pt1, pt2 = open_pair(group, sk.sk, share_ct, randomness_ct)
     kind = None
     out = []
     for pt in (pt1, pt2):

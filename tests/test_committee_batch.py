@@ -75,3 +75,256 @@ def test_batched_dealing_subset_matches_init_shape():
     _, b = dealt[0]
     assert len(b.committed_coefficients) == t + 1
     assert len(b.encrypted_shares) == n
+
+
+def _cheating_broadcast(env, keys, victim_indices, dealer_broadcast, rng):
+    """Re-seal wrong-but-decodable shares to the victims, keeping the
+    dealer's commitments — the batched twin of the reference tests'
+    hand-corrupted broadcasts (committee.rs:1127-1128, 1188)."""
+    from dkg_tpu.crypto.elgamal import seal_pair
+    from dkg_tpu.dkg.broadcast import BroadcastPhase1, EncryptedShares
+    from dkg_tpu.dkg.procedure_keys import sort_committee
+
+    fs = G.scalar_field
+    pks = sort_committee(G, [k.public() for k in keys])
+    enc = list(dealer_broadcast.encrypted_shares)
+    for v in victim_indices:
+        share_ct, rand_ct = seal_pair(
+            G,
+            pks[v - 1].point,
+            int(fs.rand_int(rng)).to_bytes(fs.nbytes, "little"),
+            int(fs.rand_int(rng)).to_bytes(fs.nbytes, "little"),
+            rng,
+        )
+        enc[v - 1] = EncryptedShares(v, share_ct, rand_ct)
+    return BroadcastPhase1(dealer_broadcast.committed_coefficients, tuple(enc))
+
+
+def test_batched_share_verification_matches_serial():
+    """The batched round-2 produces the same qualified sets, received
+    shares, complaint targets/kinds, and verifiable evidence as n serial
+    ``DkgPhase1.proceed`` calls, under a mixed fault load: one cheating
+    dealer, one silent dropout, one undecodable ciphertext."""
+    import copy
+
+    from dkg_tpu.crypto.elgamal import HybridCiphertext
+    from dkg_tpu.dkg.broadcast import BroadcastPhase1, EncryptedShares
+    from dkg_tpu.dkg.committee_batch import batched_share_verification
+    from dkg_tpu.dkg.errors import DkgErrorKind
+
+    rng = random.Random(0x5E41)
+    n, t = 8, 3
+    env = Environment.init(G, t, n, b"batched-r2")
+    keys = [MemberCommunicationKey.generate(G, rng) for _ in range(n)]
+    dealt = batched_dealing(env, rng, keys)
+    broadcasts = [b for _, b in dealt]
+
+    # dealer 3 cheats on recipients 1 and 6
+    broadcasts[2] = _cheating_broadcast(env, keys, [1, 6], broadcasts[2], rng)
+    # dealer 5 goes silent
+    broadcasts[4] = None
+    # dealer 7 sends recipient 2 an undecodable (truncated) ciphertext
+    b7 = broadcasts[6]
+    enc = list(b7.encrypted_shares)
+    es = enc[1]
+    enc[1] = EncryptedShares(
+        2, HybridCiphertext(es.share_ct.e1, es.share_ct.ciphertext[:-3]),
+        es.randomness_ct,
+    )
+    broadcasts[6] = BroadcastPhase1(b7.committed_coefficients, tuple(enc))
+
+    fetched = [
+        FetchedPhase1.from_broadcast(env, j + 1, broadcasts[j]) for j in range(n)
+    ]
+
+    serial_phases = [copy.deepcopy(p) for p, _ in dealt]
+    batch_phases = [p for p, _ in dealt]
+
+    serial = [p.proceed(fetched, random.Random(77)) for p in serial_phases]
+    batched = batched_share_verification(batch_phases, fetched, random.Random(99))
+
+    pks = [k.public() for k in keys]
+    from dkg_tpu.dkg.procedure_keys import sort_committee
+
+    sorted_pks = sort_committee(G, pks)
+    for i, ((s_nxt, s_b), (b_nxt, b_b)) in enumerate(zip(serial, batched)):
+        # same phase/error outcome
+        assert type(s_nxt) is type(b_nxt), i
+        st_s, st_b = serial_phases[i]._state, batch_phases[i]._state
+        assert st_s.qualified == st_b.qualified, i
+        assert st_s.received_shares == st_b.received_shares, i
+        assert st_s.randomized_coeffs == st_b.randomized_coeffs, i
+        # same complaints (accused, kind) in the same order
+        sc = [] if s_b is None else [
+            (m.accused_index, m.error) for m in s_b.misbehaving_parties
+        ]
+        bc = [] if b_b is None else [
+            (m.accused_index, m.error) for m in b_b.misbehaving_parties
+        ]
+        assert sc == bc, i
+        # batched evidence is cryptographically valid: complaints verify
+        if b_b is not None:
+            for m in b_b.misbehaving_parties:
+                assert m.verify(
+                    G, env.commitment_key, st_b.index, sorted_pks[st_b.index - 1],
+                    broadcasts[m.accused_index - 1],
+                ), (i, m.accused_index)
+
+    # expected verdicts: victims complain about dealer 3 / dealer 7,
+    # everyone disqualifies silent dealer 5
+    def comp(i):
+        b = batched[i][1]
+        return [] if b is None else [m.accused_index for m in b.misbehaving_parties]
+
+    assert comp(0) == [3] and comp(5) == [3] and comp(1) == [7]
+    for i in range(n):
+        if i != 4:  # a party never processes its own broadcast slot
+            assert not batch_phases[i]._state.qualified[4]
+
+
+def test_batched_share_verification_completes_ceremony_with_cheat():
+    """End-to-end wire flow at committee scale: batched dealing ->
+    batched round-2 with a cheating dealer -> serial phases 3-5; the
+    upheld complaints (adjudicated by every party, batched adjudication
+    agreeing) exclude the cheat and all honest parties derive one key."""
+    from dkg_tpu.dkg import complaints_batch as cb
+    from dkg_tpu.dkg.committee_batch import batched_share_verification
+    from dkg_tpu.groups import device as gd
+
+    rng = random.Random(0xC0DE)
+    n, t = 6, 2
+    env = Environment.init(G, t, n, b"batched-e2e")
+    keys = [MemberCommunicationKey.generate(G, rng) for _ in range(n)]
+    dealt = batched_dealing(env, rng, keys)
+    broadcasts = [b for _, b in dealt]
+    broadcasts[3] = _cheating_broadcast(env, keys, [2, 5], broadcasts[3], rng)
+
+    fetched = [
+        FetchedPhase1.from_broadcast(env, j + 1, broadcasts[j]) for j in range(n)
+    ]
+    round2 = batched_share_verification([p for p, _ in dealt], fetched, rng)
+    phases2 = [nxt for nxt, _ in round2]
+    complaints2 = [b for _, b in round2]
+    from dkg_tpu.dkg.committee import DkgPhase2
+
+    assert all(isinstance(p, DkgPhase2) for p in phases2)
+    accusers = [i + 1 for i, b in enumerate(complaints2) if b is not None]
+    assert accusers == [2, 5]
+
+    # batched adjudication agrees with what phase 2 will decide
+    from dkg_tpu.dkg.procedure_keys import sort_committee
+
+    sorted_pks = sort_committee(G, [k.public() for k in keys])
+    triples = [
+        (a, sorted_pks[a - 1], m)
+        for a in accusers
+        for m in complaints2[a - 1].misbehaving_parties
+    ]
+    cs = gd.ALL_CURVES[G.name]
+    verdicts = cb.adjudicate_round1_batch(
+        G, cs, env.commitment_key, triples,
+        {j + 1: broadcasts[j] for j in range(n)},
+    )
+    assert verdicts == [True, True]
+
+    fetched_c2 = [
+        FetchedComplaints2(i + 1, complaints2[i]) for i in range(n)
+    ]
+    phases3, b3 = [], []
+    for p in phases2:
+        nxt, b = p.proceed(fetched_c2, fetched)
+        phases3.append(nxt)
+        b3.append(b)
+    # dealer 4 is disqualified everywhere
+    for p in phases3:
+        assert p._state.qualified[3] == 0
+    phases4 = []
+    for p in phases3:
+        nxt, b = p.proceed(
+            [FetchedPhase3.from_broadcast(env, j + 1, b3[j]) for j in range(n)]
+        )
+        phases4.append(nxt)
+    phases5 = []
+    for p in phases4:
+        nxt, b = p.proceed([FetchedComplaints4(i + 1, None) for i in range(n)])
+        phases5.append(nxt)
+    results = [
+        p.finalise([FetchedPhase5(i + 1, None) for i in range(n)])[0]
+        for p in phases5
+    ]
+    masters = [m for m, _ in results]
+    for m in masters[1:]:
+        assert G.eq(m.point, masters[0].point)
+
+
+def test_batched_share_verification_error_branches():
+    """The two serial error paths reproduce exactly in the batched
+    round-2: misaddressed data -> FETCHED_INVALID_DATA (with identical
+    partial state), and > t complaints -> MISBEHAVIOUR_HIGHER_THRESHOLD
+    with the evidence broadcast still published (committee.rs:340-347)."""
+    import copy
+
+    from dkg_tpu.dkg.broadcast import BroadcastPhase1, EncryptedShares
+    from dkg_tpu.dkg.committee import DkgPhase2
+    from dkg_tpu.dkg.committee_batch import batched_share_verification
+    from dkg_tpu.dkg.errors import DkgError, DkgErrorKind
+
+    rng = random.Random(0xE44)
+    n, t = 8, 3
+    env = Environment.init(G, t, n, b"batched-r2-err")
+    keys = [MemberCommunicationKey.generate(G, rng) for _ in range(n)]
+
+    # --- (a) dealer 2 misaddresses recipient 3's slot (claims recipient 4)
+    dealt = batched_dealing(env, rng, keys)
+    broadcasts = [b for _, b in dealt]
+    b2 = broadcasts[1]
+    enc = list(b2.encrypted_shares)
+    enc[2] = EncryptedShares(4, enc[2].share_ct, enc[2].randomness_ct)
+    broadcasts[1] = BroadcastPhase1(b2.committed_coefficients, tuple(enc))
+    fetched = [
+        FetchedPhase1.from_broadcast(env, j + 1, broadcasts[j]) for j in range(n)
+    ]
+    serial_phases = [copy.deepcopy(p) for p, _ in dealt]
+    serial = [p.proceed(fetched, random.Random(7)) for p in serial_phases]
+    batched = batched_share_verification(
+        [p for p, _ in dealt], fetched, random.Random(9)
+    )
+    for i, ((s_nxt, _), (b_nxt, _)) in enumerate(zip(serial, batched)):
+        assert type(s_nxt) is type(b_nxt), i
+        # identical partial state even on the early-exit path
+        assert (
+            serial_phases[i]._state.received_shares
+            == dealt[i][0]._state.received_shares
+        ), i
+        assert serial_phases[i]._state.qualified == dealt[i][0]._state.qualified, i
+    err = batched[2][0]
+    assert isinstance(err, DkgError)
+    assert err.kind == DkgErrorKind.FETCHED_INVALID_DATA
+    assert batched[2][1] is None  # no broadcast on the early exit
+
+    # --- (b) four cheating dealers > t=3: threshold abort, evidence kept
+    dealt2 = batched_dealing(env, rng, keys)
+    broadcasts2 = [b for _, b in dealt2]
+    for d in (1, 2, 4, 7):
+        broadcasts2[d - 1] = _cheating_broadcast(
+            env, keys, [6], broadcasts2[d - 1], rng
+        )
+    fetched2 = [
+        FetchedPhase1.from_broadcast(env, j + 1, broadcasts2[j]) for j in range(n)
+    ]
+    serial2_phases = [copy.deepcopy(p) for p, _ in dealt2]
+    serial2 = [p.proceed(fetched2, random.Random(5)) for p in serial2_phases]
+    batched2 = batched_share_verification(
+        [p for p, _ in dealt2], fetched2, random.Random(6)
+    )
+    err6, bb6 = batched2[5]
+    assert isinstance(err6, DkgError)
+    assert err6.kind == DkgErrorKind.MISBEHAVIOUR_HIGHER_THRESHOLD
+    assert bb6 is not None
+    assert [m.accused_index for m in bb6.misbehaving_parties] == [1, 2, 4, 7]
+    s_err6, s_b6 = serial2[5]
+    assert isinstance(s_err6, DkgError) and s_err6.kind == err6.kind
+    assert [m.accused_index for m in s_b6.misbehaving_parties] == [1, 2, 4, 7]
+    for i in range(n):
+        if i != 5:
+            assert isinstance(batched2[i][0], DkgPhase2), i
