@@ -281,6 +281,74 @@ def _ws_double(cs: CurveSpec, p: jax.Array) -> jax.Array:
     return _stack(x3, y3, z3)
 
 
+def _ed_madd(cs: CurveSpec, p: jax.Array, q: jax.Array) -> jax.Array:
+    """Mixed unified Edwards add: q affine (Z2 == 1, T2 = X2*Y2).
+
+    add-2008-hwcd-3 with the D = 2*Z1*Z2 multiply specialised away —
+    8 muls instead of 9.  Still unified/complete (the affine identity
+    (0, 1, 1, 0) flows through like any point)."""
+    f = cs.field
+    x1, y1, z1, t1 = _unstack(p, 4)
+    x2, y2, _, t2 = _unstack(q, 4)
+    a = fd.mul(f, fd.sub(f, y1, x1), fd.sub(f, y2, x2))
+    b = fd.mul(f, fd.add(f, y1, x1), fd.add(f, y2, x2))
+    c = fd.mul(f, fd.mul(f, t1, fd.constant(f, cs.const)), t2)
+    d = fd.add(f, z1, z1)  # 2*Z1*Z2 with Z2 = 1
+    e = fd.sub(f, b, a)
+    ff = fd.sub(f, d, c)
+    g = fd.add(f, d, c)
+    h = fd.add(f, b, a)
+    return _stack(
+        fd.mul(f, e, ff), fd.mul(f, g, h), fd.mul(f, ff, g), fd.mul(f, e, h)
+    )
+
+
+def _ws_madd(cs: CurveSpec, p: jax.Array, q: jax.Array) -> jax.Array:
+    """Mixed addition for y^2 = x^3 + b: q affine (RCB15 algorithm 8).
+
+    11 muls vs algorithm 7's 12 (T2 = Z1*Z2 becomes Z1; the (Y1+Z1)
+    (Y2+Z2) and (X1+Z1)(X2+Z2) cross terms collapse to Y2*Z1 + Y1 and
+    X2*Z1 + X1).  Complete for every P INCLUDING the identity, but NOT
+    for q = identity (Z2 would be 0, not 1) — callers must mask
+    zero-digit table entries (see _fixed_base_mul_core)."""
+    f = cs.field
+    b3 = fd.constant(f, cs.const)
+    x1, y1, z1 = _unstack(p, 3)
+    x2, y2, _ = _unstack(q, 3)
+    t0 = fd.mul(f, x1, x2)
+    t1 = fd.mul(f, y1, y2)
+    t3 = fd.mul(f, fd.add(f, x1, y1), fd.add(f, x2, y2))
+    t3 = fd.sub(f, fd.sub(f, t3, t0), t1)
+    t4 = fd.add(f, fd.mul(f, y2, z1), y1)
+    y3 = fd.add(f, fd.mul(f, x2, z1), x1)
+    x3 = fd.add(f, fd.add(f, t0, t0), t0)
+    t2 = fd.mul(f, b3, z1)
+    z3 = fd.add(f, t1, t2)
+    t1 = fd.sub(f, t1, t2)
+    y3 = fd.mul(f, b3, y3)
+    x_out = fd.sub(f, fd.mul(f, t3, t1), fd.mul(f, t4, y3))
+    y_out = fd.add(f, fd.mul(f, t1, z3), fd.mul(f, x3, y3))
+    z_out = fd.add(f, fd.mul(f, z3, t4), fd.mul(f, x3, t3))
+    return _stack(x_out, y_out, z_out)
+
+
+@_jit_static0
+def _madd_xla(cs: CurveSpec, p: jax.Array, q: jax.Array) -> jax.Array:
+    if cs.kind == "edwards":
+        return _ed_madd(cs, p, q)
+    return _ws_madd(cs, p, q)
+
+
+def madd(cs: CurveSpec, p: jax.Array, q: jax.Array) -> jax.Array:
+    """p + q with q affine-normalised (Z = 1) — one mul cheaper than the
+    general add.  Weierstrass callers must not pass q = identity."""
+    if fused_kernels_active():
+        from ..ops import pallas_point
+
+        return pallas_point.pt_madd(cs, p, q)
+    return _madd_xla(cs, p, q)
+
+
 @_jit_static0
 def eq(cs: CurveSpec, p: jax.Array, q: jax.Array) -> jax.Array:
     """Batched projective equality -> bool array over the batch shape.
@@ -317,7 +385,8 @@ def scalar_windows(cs: CurveSpec, k: jax.Array, window: int = WINDOW) -> jax.Arr
     """(..., L) scalar limbs -> (..., NW) window-bit digits, little-endian.
 
     ``window`` must divide 16 (the limb width): 4 for per-lane tables
-    (variable base), 8 for host-precomputed fixed-base tables.
+    (variable base), 8 for host-precomputed fixed-base tables, 16 for
+    the device-built fixed-base tables (one digit per limb).
     """
     shifts = jnp.arange(0, 16, window, dtype=jnp.uint32)
     digits = (k[..., :, None] >> shifts) & jnp.uint32((1 << window) - 1)
@@ -499,17 +568,99 @@ def _affine_limbs(cs: CurveSpec, host_group, p) -> np.ndarray:
 
 
 def fixed_base_table(cs: CurveSpec, base) -> jax.Array:
-    """Device window table for a fixed host-side base point."""
+    """Device window table for a fixed base point.
+
+    Backend-matched window width: on TPU the table is DEVICE-BUILT with
+    16-bit windows — 16 mixed adds per 256-bit scalar instead of 32,
+    for ~200 MB of HBM per base (a clear trade: the commitment phase is
+    add-bound, HBM is plentiful, and the build is one batched ladder
+    call amortised over the whole ceremony).  Elsewhere the 8-bit
+    host-built table.  DKG_TPU_FB_WINDOW=4/8/16 forces a width (any
+    non-host width builds on device).
+    """
+    import os
+
+    env = os.environ.get("DKG_TPU_FB_WINDOW")
+    if env is not None:
+        window = int(env)
+        if window == FIXED_WINDOW:
+            return jnp.asarray(_fixed_table_np(cs, base_key(cs, base)))
+        return fixed_base_table_dev(cs, base, window)
+    if fd._on_tpu():
+        return fixed_base_table_dev(cs, base, 16)
     return jnp.asarray(_fixed_table_np(cs, base_key(cs, base)))
 
 
-def fixed_base_mul(cs: CurveSpec, table: jax.Array, k: jax.Array) -> jax.Array:
-    """Batched k·B for fixed B: table (NW, 16, C, L), k (..., L).
+def fixed_base_table_dev(cs: CurveSpec, base, window: int = 16) -> jax.Array:
+    """Device-built affine window table: (NW, 2**window, C, L).
 
-    NW gathered adds, no doublings — the workhorse for coefficient
-    commitments g·a + h·b (reference hot loop committee.rs:151-159) and
-    KEM first components g·r (reference: elgamal.rs:138-142).  Eager
-    calls are flattened + power-of-two padded (see _canon_batch).
+    T[w][d] = d * (2**window)^w * B, affine-normalised (Z = 1) like the
+    host table, with the same identity convention for entry 0 (Edwards
+    (0,1,1,0) — genuinely affine; Weierstrass (0,1,0) — masked by the
+    digit-0 select in _fixed_base_mul_core).  Built as one batched
+    ladder per window base + a single Montgomery-trick inversion over
+    all entries; cached per (curve, base, window).
+    """
+    return _fixed_table_dev_cached(cs, base_key(cs, base), window)
+
+
+@functools.lru_cache(maxsize=8)
+def _fixed_table_dev_cached(cs: CurveSpec, key: tuple, window: int) -> jax.Array:
+    f = cs.field
+    host_group = gh.ALL_GROUPS[cs.name]
+    base = base_key_to_point(cs, key)
+    nw = _n_windows(cs, window)
+    entries = 1 << window
+    # window bases (2**window)^w * B: nw public host scalar-mults
+    bases = []
+    pt = base
+    for _ in range(nw):
+        bases.append(pt)
+        for _ in range(window):
+            pt = host_group.add(pt, pt)
+    bases_dev = from_host(cs, bases)  # (nw, C, L)
+    digits = jnp.broadcast_to(
+        jnp.arange(entries, dtype=jnp.uint32)[None, :], (nw, entries)
+    )
+    pts = scalar_mul_small(
+        cs, digits, jnp.broadcast_to(bases_dev[:, None], (nw, entries, cs.ncoords, f.limbs)),
+        window,
+    )  # (nw, entries, C, L) projective
+    # affine-normalise with ONE batched inversion; zero-Z lanes (the
+    # Weierstrass identity at digit 0) are guarded then overwritten
+    z = pts[..., 2, :]
+    z_is_zero = fd.is_zero(z)
+    z_safe = fd.select(z_is_zero, jnp.broadcast_to(fd.ones(f), z.shape), z)
+    # Montgomery trick with a SHORT scan axis (256) and everything else
+    # batched wide — a flat scan over nw * 2**window lanes would
+    # serialize ~1M multiply steps
+    flat = z_safe.reshape(-1, f.limbs)
+    rows = 256 if flat.shape[0] % 256 == 0 else 1
+    zi = fd.batch_inv(f, flat.reshape(rows, -1, f.limbs), axis=0).reshape(z.shape)
+    x_a = fd.mul(f, pts[..., 0, :], zi)
+    y_a = fd.mul(f, pts[..., 1, :], zi)
+    one = jnp.broadcast_to(fd.ones(f), x_a.shape)
+    if cs.kind == "edwards":
+        t_a = fd.mul(f, x_a, y_a)
+        out = jnp.stack([x_a, y_a, one, t_a], axis=-2)
+    else:
+        out = jnp.stack([x_a, y_a, one], axis=-2)
+        ident = identity(cs)  # (C, L): (0, 1, 0)
+        out = jnp.where(
+            z_is_zero[..., None, None], jnp.broadcast_to(ident, out.shape), out
+        )
+    return out
+
+
+def fixed_base_mul(cs: CurveSpec, table: jax.Array, k: jax.Array) -> jax.Array:
+    """Batched k·B for fixed B: table (NW, 2**w, C, L), k (..., L).
+
+    The window width w (4/8/16) is encoded in the table's entry count;
+    NW = 256/w windows of one gathered MIXED add each, no doublings —
+    the workhorse for coefficient commitments g·a + h·b (reference hot
+    loop committee.rs:151-159) and KEM first components g·r (reference:
+    elgamal.rs:138-142).  Eager calls are flattened + power-of-two
+    padded (see _canon_batch).
     """
     if isinstance(k, jax.core.Tracer) or isinstance(table, jax.core.Tracer):
         return _fixed_base_mul_core(cs, table, k)
@@ -528,15 +679,24 @@ def fixed_base_mul(cs: CurveSpec, table: jax.Array, k: jax.Array) -> jax.Array:
 @_jit_static0
 def _fixed_base_mul_core(cs: CurveSpec, table: jax.Array, k: jax.Array) -> jax.Array:
     # window width is encoded in the table's entry count (16 -> 4-bit,
-    # 256 -> 8-bit); both divide the 16-bit limb width.
+    # 256 -> 8-bit, 65536 -> 16-bit); all divide the 16-bit limb width.
     window = int(table.shape[1]).bit_length() - 1
     digits = scalar_windows(cs, k, window)  # (..., NW)
     sel = jnp.moveaxis(digits, -1, 0)  # (NW, ...)
 
     def step(acc, args):
+        # Table entries are affine-normalised (Z = 1), so each window is
+        # a mixed add.  Weierstrass identity entries are NOT affine —
+        # they are stored (0, 1, 0) — so mask on the gathered entry's
+        # Z = 0 (covers both the digit-0 entry and every entry of an
+        # identity-base table); the Edwards identity (0, 1, 1, 0) is
+        # affine and flows through the unified madd.
         tab_w, dig = args  # (2**window, C, L), (...)
         entry = _gather_table(tab_w, dig)
-        return add(cs, acc, entry), None
+        nxt = madd(cs, acc, entry)
+        if cs.kind != "edwards":
+            nxt = select(~fd.is_zero(entry[..., 2, :]), nxt, acc)
+        return nxt, None
 
     init = identity(cs, k.shape[:-1])
     acc, _ = lax.scan(step, init, (table, sel))

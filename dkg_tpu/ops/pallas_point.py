@@ -140,6 +140,59 @@ def _ws_double_rows(cs: CurveSpec, p_rows):
     return (x3, y3, z3)
 
 
+def _ed_madd_rows(cs: CurveSpec, p_rows, q_rows):
+    """Mixed unified Edwards add: q affine (Z2 == 1) — the 2*Z1*Z2
+    multiply collapses to 2*Z1 (see groups/device._ed_madd)."""
+    f = cs.field
+    x1, y1, z1, t1 = p_rows
+    x2, y2, _, t2 = q_rows
+    a = mod_mul_rows(f, mod_sub_rows(f, y1, x1), mod_sub_rows(f, y2, x2))
+    b = mod_mul_rows(f, mod_add_rows(f, y1, x1), mod_add_rows(f, y2, x2))
+    d2 = _const_rows(f, cs.const, x1[0])
+    c = mod_mul_rows(f, mod_mul_rows(f, t1, d2), t2)
+    d = mod_add_rows(f, z1, z1)
+    e = mod_sub_rows(f, b, a)
+    ff = mod_sub_rows(f, d, c)
+    g = mod_add_rows(f, d, c)
+    h = mod_add_rows(f, b, a)
+    return (
+        mod_mul_rows(f, e, ff),
+        mod_mul_rows(f, g, h),
+        mod_mul_rows(f, ff, g),
+        mod_mul_rows(f, e, h),
+    )
+
+
+def _ws_madd_rows(cs: CurveSpec, p_rows, q_rows):
+    """Mixed addition, q affine (RCB15 algorithm 8) — NOT valid for
+    q = identity; callers mask zero digits (see groups/device._ws_madd)."""
+    f = cs.field
+    x1, y1, z1 = p_rows
+    x2, y2, _ = q_rows
+    b3 = _const_rows(f, cs.const, x1[0])
+    t0 = mod_mul_rows(f, x1, x2)
+    t1 = mod_mul_rows(f, y1, y2)
+    t3 = mod_mul_rows(f, mod_add_rows(f, x1, y1), mod_add_rows(f, x2, y2))
+    t3 = mod_sub_rows(f, mod_sub_rows(f, t3, t0), t1)
+    t4 = mod_add_rows(f, mod_mul_rows(f, y2, z1), y1)
+    y3 = mod_add_rows(f, mod_mul_rows(f, x2, z1), x1)
+    x3 = mod_add_rows(f, mod_add_rows(f, t0, t0), t0)
+    t2 = mod_mul_rows(f, b3, z1)
+    z3 = mod_add_rows(f, t1, t2)
+    t1 = mod_sub_rows(f, t1, t2)
+    y3 = mod_mul_rows(f, b3, y3)
+    x_out = mod_sub_rows(f, mod_mul_rows(f, t3, t1), mod_mul_rows(f, t4, y3))
+    y_out = mod_add_rows(f, mod_mul_rows(f, t1, z3), mod_mul_rows(f, x3, y3))
+    z_out = mod_add_rows(f, mod_mul_rows(f, z3, t4), mod_mul_rows(f, x3, t3))
+    return (x_out, y_out, z_out)
+
+
+def _madd_rows(cs: CurveSpec, p_rows, q_rows):
+    if cs.kind == "edwards":
+        return _ed_madd_rows(cs, p_rows, q_rows)
+    return _ws_madd_rows(cs, p_rows, q_rows)
+
+
 def _add_rows(cs: CurveSpec, p_rows, q_rows):
     if cs.kind == "edwards":
         return _ed_add_rows(cs, p_rows, q_rows)
@@ -201,6 +254,27 @@ def _add_call(cs: CurveSpec, p_t: jax.Array, q_t: jax.Array, interpret: bool):
     def kernel(p_ref, q_ref, out_ref):
         _rows_out(
             out_ref, _add_rows(cs, _rows_in(p_ref, L, C), _rows_in(q_ref, L, C)), L
+        )
+
+    B = p_t.shape[-1]
+    spec = _point_spec(cs)
+    return pl.pallas_call(
+        kernel,
+        grid=(B // BLOCK,),
+        in_specs=[spec, spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((C * L, B), jnp.uint32),
+        interpret=interpret,
+    )(p_t, q_t)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def _madd_call(cs: CurveSpec, p_t: jax.Array, q_t: jax.Array, interpret: bool):
+    L, C = cs.field.limbs, cs.ncoords
+
+    def kernel(p_ref, q_ref, out_ref):
+        _rows_out(
+            out_ref, _madd_rows(cs, _rows_in(p_ref, L, C), _rows_in(q_ref, L, C)), L
         )
 
     B = p_t.shape[-1]
@@ -367,6 +441,20 @@ def pt_add(cs: CurveSpec, p: jax.Array, q: jax.Array, *, interpret: bool | None 
     p_t, batch, n = _to_tiles(cs, p)
     q_t, _, _ = _to_tiles(cs, q)
     out = _add_call(cs, p_t, q_t, _interp() if interpret is None else interpret)
+    return _from_tiles(cs, out, batch, n)
+
+
+def pt_madd(cs: CurveSpec, p: jax.Array, q: jax.Array, *, interpret: bool | None = None) -> jax.Array:
+    """Fused mixed add: q affine-normalised (Z = 1).  Weierstrass
+    callers must not pass q = identity (see groups/device.madd)."""
+    if not HAVE_PALLAS:  # pragma: no cover
+        from ..groups import device as gd
+
+        return gd._madd_xla(cs, p, q)
+    p, q = jnp.broadcast_arrays(jnp.asarray(p, jnp.uint32), jnp.asarray(q, jnp.uint32))
+    p_t, batch, n = _to_tiles(cs, p)
+    q_t, _, _ = _to_tiles(cs, q)
+    out = _madd_call(cs, p_t, q_t, _interp() if interpret is None else interpret)
     return _from_tiles(cs, out, batch, n)
 
 

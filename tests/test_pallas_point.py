@@ -193,3 +193,18 @@ def test_kernel_window_and_ladder_tpu(curve):
         acc = gd.select((xs >> i) & 1 != 0, gd._add_xla(cs, acc, pts), acc)
     want_l = gd._add_xla(cs, acc, ent)
     assert bool(jnp.all(gd.eq(cs, got_l, want_l)))
+
+
+@pytest.mark.parametrize("cs", TOY_CURVES, ids=lambda c: c.kind)
+def test_toy_madd_rows_matches_xla(cs):
+    """_madd_rows == _madd_xla == _add_xla when the second operand's Z
+    coordinate is 1 (the affine-table contract of fixed_base_mul)."""
+    p = _toy_points_dev(cs, 9)
+    q = np.asarray(_toy_points_dev(cs, 9)).copy()
+    z_one = np.zeros(cs.field.limbs, np.uint32)
+    z_one[0] = 1
+    q[:, 2, :] = z_one  # force Z2 = 1 (coordinate index 2 on both kinds)
+    q = jnp.asarray(q)
+    got = _from_rows(cs, pp._madd_rows(cs, _to_rows(cs, p), _to_rows(cs, q)))
+    assert jnp.all(got == gd._madd_xla(cs, p, q))
+    assert jnp.all(got == gd._add_xla(cs, p, q))
