@@ -1,6 +1,7 @@
 """Component-level timing of the bench workload (deal + verify_batch)
 at n=1024 t=341 secp256k1 on the real chip.  Coarse (seconds-scale)
-but trustworthy: each stage is block_until_ready'd."""
+but trustworthy: each stage is synced with a host readback (bench.sync
+— on axon, block_until_ready returns before execution completes)."""
 from __future__ import annotations
 
 import os
@@ -29,12 +30,15 @@ cs = cfg.cs
 fs = cs.scalar
 
 
+from bench import sync as _sync  # the one definition of the readback barrier
+
+
 def timed(name, fn, *args):
     out = fn(*args)
-    jax.block_until_ready(out)
+    _sync(out)
     t0 = time.perf_counter()
     out = fn(*args)
-    jax.block_until_ready(out)
+    _sync(out)
     print(f"{name:26s} {time.perf_counter()-t0:8.3f} s", flush=True)
     return out
 
