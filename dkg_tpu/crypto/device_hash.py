@@ -259,5 +259,9 @@ def tree_digest_host(words, domain: int = 0) -> list[int]:
 
 
 def digest_to_bytes(digest) -> bytes:
-    """(8,) uint32 digest -> 32 little-endian bytes."""
+    """(8,) uint32 digest -> 32 little-endian bytes.
+
+    Host-side convenience for EXTERNAL verifiers serialising tree/row
+    digests; the in-package transcript fold consumes the uint32 arrays
+    directly (dkg.ceremony._fold_digest_device)."""
     return b"".join(int(x).to_bytes(4, "little") for x in np.asarray(digest))

@@ -329,25 +329,30 @@ def _fold_digest(cfg: CeremonyConfig, a_np: np.ndarray, e_np: np.ndarray,
     return h.digest()
 
 
-def _fold_digest_device(cfg: CeremonyConfig, da, de, rows) -> bytes:
+def _fold_digest_device(cfg: CeremonyConfig, rows_a, rows_e, rows_sr) -> bytes:
     """Outer fold shared by the flat and sharded device digests: binds
-    the two Merkle roots + all dealer row digests in dealer order."""
-    from ..crypto import device_hash as dh
-
+    the three per-dealer row-digest arrays in dealer order."""
     h = hashlib.blake2b(digest_size=32, person=b"dkgtpu-trd")
     h.update(f"{cfg.curve}|{cfg.n}|{cfg.t}|".encode())
-    h.update(dh.digest_to_bytes(da))
-    h.update(dh.digest_to_bytes(de))
-    h.update(np.ascontiguousarray(np.asarray(rows, np.uint32)))
+    for rows in (rows_a, rows_e, rows_sr):
+        h.update(np.ascontiguousarray(np.asarray(rows, np.uint32)))
     return h.digest()
 
 
-def _row_digests_device(cfg: CeremonyConfig, shares, hidings) -> jax.Array:
-    """(k, n, L) x2 dealer rows -> (k, 8) uint32 BLAKE2s row digests;
-    depends only on each dealer's own rows, so shards hash locally."""
+def _dealer_rows_device(cfg: CeremonyConfig, a_comm, e_comm, shares, hidings):
+    """Per-dealer BLAKE2s row digests of all four round-1 tensors:
+    (k, ...) local-dealer slices -> three (k, 8) uint32 arrays.
+
+    Every array is row-digested along the dealer axis (never tree-hashed
+    flat), so EVERY part of the transcript is shard-foldable — a mesh
+    that keeps commitments dealer-sharded (no allgather) still derives
+    the canonical digest by exchanging 3 x 32 bytes per dealer.
+    """
     from ..crypto import device_hash as dh
 
     k = shares.shape[0]
+    rows_a = dh.row_digests(jnp.asarray(a_comm, jnp.uint32).reshape(k, -1), domain=1)
+    rows_e = dh.row_digests(jnp.asarray(e_comm, jnp.uint32).reshape(k, -1), domain=2)
     sr = jnp.concatenate(
         [
             jnp.asarray(shares, jnp.uint32).reshape(k, -1),
@@ -355,7 +360,8 @@ def _row_digests_device(cfg: CeremonyConfig, shares, hidings) -> jax.Array:
         ],
         axis=-1,
     )
-    return dh.row_digests(sr, domain=3)
+    rows_sr = dh.row_digests(sr, domain=3)
+    return rows_a, rows_e, rows_sr
 
 
 def transcript_digest_device(
@@ -366,19 +372,16 @@ def transcript_digest_device(
     Same binding guarantee as the byte-level :func:`transcript_digest`
     (every limb of all four round-1 tensors), different digest function:
     the tensors are hashed where they live with the BLAKE2s Merkle tree
-    (crypto.device_hash) and only 32-byte roots + (n, 32)-byte dealer
-    row digests reach the host — instead of shipping ~2 GB of share
-    matrices at n=4096.  Shard-foldable: each dealer's row digest
-    depends only on that dealer's rows, so sharded meshes exchange 32
-    bytes per dealer (:func:`sharded_transcript_digest` computes this
-    exact value from dealer-sharded arrays).
+    (crypto.device_hash) and only (n, 32)-byte dealer row digests reach
+    the host — instead of shipping ~2 GB of share matrices at n=4096.
+    Fully shard-foldable along the dealer axis (commitments included),
+    so a mesh never needs the replicated tensors just to hash them
+    (:func:`sharded_transcript_digest` computes this exact value from
+    dealer-sharded arrays).
     """
-    from ..crypto import device_hash as dh
-
-    da = dh.tree_digest(a_comm, domain=1)
-    de = dh.tree_digest(e_comm, domain=2)
-    rows = _row_digests_device(cfg, shares, hidings)  # (n, 8)
-    return _fold_digest_device(cfg, da, de, rows)
+    return _fold_digest_device(
+        cfg, *_dealer_rows_device(cfg, a_comm, e_comm, shares, hidings)
+    )
 
 
 def transcript_digest(cfg: CeremonyConfig, a_comm, e_comm, shares, hidings) -> bytes:
@@ -402,40 +405,48 @@ def transcript_digest(cfg: CeremonyConfig, a_comm, e_comm, shares, hidings) -> b
     return _fold_digest(cfg, np.asarray(a_comm), np.asarray(e_comm), rows)
 
 
-def sharded_transcript_digest(cfg: CeremonyConfig, a_all, e_all, s, r) -> bytes:
+def sharded_transcript_digest(cfg: CeremonyConfig, a, e, s, r) -> bytes:
     """transcript_digest_device over mesh-sharded round-1 output.
 
-    a_all/e_all are replicated (locally addressable on every process);
-    s/r are dealer-sharded.  Each process Merkle-hashes its local dealer
-    rows ON DEVICE; only the 32-byte row digests cross process
+    ALL FOUR tensors are dealer-sharded (the scalable mesh layout never
+    replicates the commitments).  Each process Merkle-hashes its local
+    dealer rows ON DEVICE; only 3 x 32 bytes per dealer cross process
     boundaries, so this works on multi-host meshes where
     ``np.asarray(s)`` would fail (shards on non-addressable devices).
     Bit-identical to ``transcript_digest_device`` on the unsharded
     arrays — the sharded and single-chip engines derive the SAME rho
-    from the same transcript.
+    from the same transcript.  All four tensors must share ONE dealer
+    layout: either all dealer-sharded identically or all replicated
+    (mixed layouts fail the identical-sharding assertion).
     """
-    from ..crypto import device_hash as dh
-
-    da = dh.tree_digest(a_all, domain=1)
-    de = dh.tree_digest(e_all, domain=2)
-    rows = np.zeros((cfg.n, 8), np.uint32)
-    shards_s = sorted(s.addressable_shards, key=lambda sh: sh.index[0].start or 0)
-    shards_r = sorted(r.addressable_shards, key=lambda sh: sh.index[0].start or 0)
+    rows = [np.zeros((cfg.n, 8), np.uint32) for _ in range(3)]
+    per = []
+    for t in (a, e, s, r):
+        shards = sorted(
+            t.addressable_shards, key=lambda sh: sh.index[0].start or 0
+        )
+        per.append(shards)
     seen = set()
-    for sh_s, sh_r in zip(shards_s, shards_r):
+    for sh_a, sh_e, sh_s, sh_r in zip(*per):
         sl = sh_s.index[0]
-        assert sh_r.index[0] == sl, "s/r must be sharded identically"
+        assert sh_r.index[0] == sl and sh_a.index[0] == sl and sh_e.index[0] == sl, (
+            "round-1 tensors must be sharded identically on the dealer axis"
+        )
         if (sl.start, sl.stop) in seen:  # replicated shard copy
             continue
         seen.add((sl.start, sl.stop))
-        rows[sl] = np.asarray(_row_digests_device(cfg, sh_s.data, sh_r.data))
+        ra, re, rsr = _dealer_rows_device(
+            cfg, sh_a.data, sh_e.data, sh_s.data, sh_r.data
+        )
+        for dst, src in zip(rows, (ra, re, rsr)):
+            dst[sl] = np.asarray(src)
     if jax.process_count() > 1:  # pragma: no cover — single-process CI
         from jax.experimental import multihost_utils as mhu
 
-        gathered = np.asarray(mhu.process_allgather(jnp.asarray(rows)))
+        gathered = np.asarray(mhu.process_allgather(jnp.asarray(np.stack(rows))))
         # each dealer row is owned by exactly one process; others are 0
-        rows = np.bitwise_or.reduce(gathered, axis=0)
-    return _fold_digest_device(cfg, da, de, rows)
+        rows = list(np.bitwise_or.reduce(gathered, axis=0))
+    return _fold_digest_device(cfg, *rows)
 
 
 def fiat_shamir_rho(cfg: CeremonyConfig, transcript: bytes, rho_bits: int) -> np.ndarray:

@@ -94,12 +94,17 @@ def sharded_deal(
     g_table: jax.Array,  # replicated
     h_table: jax.Array,
 ):
-    """Round 1 over the mesh: local dealing + commitment allgather.
+    """Round 1 over the mesh: local dealing, EVERYTHING dealer-sharded.
 
-    Returns (a_all, e_all, s, r): commitments replicated (everyone has
-    fetched the broadcast), share matrices dealer-sharded — exactly the
-    public state a party holds at the end of round 1, which is what the
-    Fiat-Shamir transcript must bind before rho can exist.
+    Returns (a, e, s, r) all sharded on the dealer axis.  The round-1
+    "broadcast" is deliberately NOT an allgather: replicating the
+    commitment tensor is what caps committee size (at n=16384, t=5461
+    the E tensor alone is ~17 GB — more than a v5e chip's HBM).  What
+    verification actually consumes is (a) the rho-combined commitment
+    columns, exchanged later as ndev partial point-RLCs of (t+1, C, L)
+    each (sharded_verify_finalise), and (b) the transcript digest,
+    exchanged as 32-byte per-dealer row digests
+    (ce.sharded_transcript_digest) — both O(t + n), not O(n*t).
     """
     _check_mesh(cfg, mesh)
 
@@ -107,14 +112,10 @@ def sharded_deal(
         _shard_map_nocheck,
         mesh=mesh,
         in_specs=(P(PARTY_AXIS), P(PARTY_AXIS), P(), P()),
-        out_specs=(P(), P(), P(PARTY_AXIS), P(PARTY_AXIS)),
+        out_specs=(P(PARTY_AXIS), P(PARTY_AXIS), P(PARTY_AXIS), P(PARTY_AXIS)),
     )
     def step(ca, cb, gt, ht):
-        a, e, s, r = ce.deal(cfg, ca, cb, gt, ht)
-        # --- "broadcast + fetch" = ICI allgather of commitments
-        e_all = lax.all_gather(e, PARTY_AXIS, tiled=True)  # (n, t+1, C, L)
-        a_all = lax.all_gather(a, PARTY_AXIS, tiled=True)
-        return a_all, e_all, s, r
+        return ce.deal(cfg, ca, cb, gt, ht)
 
     return step(coeffs_a, coeffs_b, g_table, h_table)
 
@@ -122,8 +123,8 @@ def sharded_deal(
 def sharded_verify_finalise(
     cfg: ce.CeremonyConfig,
     mesh: Mesh,
-    a_all: jax.Array,  # (n, t+1, C, L) replicated bare commitments
-    e_all: jax.Array,  # (n, t+1, C, L) replicated randomized commitments
+    a: jax.Array,  # (n, t+1, C, L) dealer-sharded bare commitments
+    e: jax.Array,  # (n, t+1, C, L) dealer-sharded randomized commitments
     s: jax.Array,  # (n, n, L) dealer-sharded share matrix
     r: jax.Array,
     g_table: jax.Array,
@@ -131,37 +132,69 @@ def sharded_verify_finalise(
     rho: jax.Array,  # (n, L) replicated Fiat-Shamir randomizers
     rho_bits: int,
 ):
-    """Round 2 + finalise over the mesh.
+    """Round 2 + finalise over the mesh, commitments never replicated.
 
-    Share delivery (dealer-sharded -> recipient-sharded) rides an
-    all_to_all; each shard batch-verifies its recipient block, then
-    aggregates shares and the master key.  Returns (ok, final_shares,
-    master): ok/final_shares recipient-sharded, master replicated.
+    Collectives per shard — O(ndev * t) for the gathered RLC partials
+    and O(n * n/ndev) for the share all_to_all; crucially nothing is
+    O(n * t), so the layout scales to the n=16384 BASELINE config where
+    a replicated E tensor (~17 GB) would not fit in HBM:
+
+    * share delivery dealer-sharded -> recipient-sharded: ``all_to_all``
+      of the share/hiding matrices;
+    * the rho-combined commitment columns D_l = sum_j rho_j E_{j,l}:
+      each shard point-RLCs its OWN dealers with its slice of rho, then
+      one ``all_gather`` of the ndev partial (t+1, C, L) column tensors
+      + a local tree-add;
+    * the master key: local tree-add of the shard's bare A_{j,0} +
+      ``all_gather`` of ndev partial points.
+
+    Returns (ok, final_shares, master): ok/final_shares
+    recipient-sharded, master replicated.
     """
     n_dev = _check_mesh(cfg, mesh)
+    cs = cfg.cs
 
     @functools.partial(
         _shard_map_nocheck,
         mesh=mesh,
-        in_specs=(P(), P(), P(PARTY_AXIS), P(PARTY_AXIS), P(), P(), P()),
+        in_specs=(P(PARTY_AXIS), P(PARTY_AXIS), P(PARTY_AXIS), P(PARTY_AXIS), P(), P(), P()),
         out_specs=(P(PARTY_AXIS), P(PARTY_AXIS), P()),
     )
-    def step(a_g, e_g, s_sh, r_sh, gt, ht, rho_all):
+    def step(a_sh, e_sh, s_sh, r_sh, gt, ht, rho_all):
         # --- share delivery: dealer-sharded -> recipient-sharded
         s_recv = lax.all_to_all(s_sh, PARTY_AXIS, split_axis=1, concat_axis=0, tiled=True)
         r_recv = lax.all_to_all(r_sh, PARTY_AXIS, split_axis=1, concat_axis=0, tiled=True)
-        # --- round 2: RLC batch verification of the local recipient block
         shard = lax.axis_index(PARTY_AXIS)
         block = cfg.n // n_dev
         first = shard * block + 1
-        ok = _verify_block(cfg, e_g, s_recv, r_recv, rho_all, rho_bits, gt, ht, first, block)
+        # --- combined commitment columns: partial RLC over local dealers,
+        # then gather + tree-add the ndev partials (point sum, NOT psum:
+        # limbs don't add elementwise)
+        rho_local = lax.dynamic_slice_in_dim(rho_all, shard * block, block, 0)
+        d_part = ce._point_rlc(cs, rho_local, e_sh, rho_bits)  # (t+1, C, L)
+        d_all = lax.all_gather(d_part, PARTY_AXIS)  # (ndev, t+1, C, L)
+        d_comm = gd._tree_reduce(cs, jnp.moveaxis(d_all, 0, -3), n_dev)
+        # --- round 2: RLC batch verification of the local recipient block
+        ok = _verify_block(
+            cfg, d_comm, s_recv, r_recv, rho_all, rho_bits, gt, ht, first, block
+        )
         # --- aggregation + master key (all dealers qualified: happy path)
         qualified = jnp.ones((cfg.n,), bool)
         finals = ce.aggregate_shares(cfg, s_recv, qualified)
-        master = ce.master_key_from_bare(cfg, a_g, qualified)
+        # mask the shard's bare A_{j,0} by ITS slice of the qualified
+        # set before reducing — same semantics as the single-device
+        # master_key_from_bare, so wiring a real qualified mask in later
+        # cannot diverge from the aggregated shares
+        q_local = lax.dynamic_slice_in_dim(qualified, shard * block, block, 0)
+        a0 = gd.select(
+            q_local, a_sh[:, 0], gd.identity(cs, (block,))
+        )
+        m_part = gd._tree_reduce(cs, a0, block)  # (C, L)
+        m_all = lax.all_gather(m_part, PARTY_AXIS)  # (ndev, C, L)
+        master = gd._tree_reduce(cs, m_all, n_dev)
         return ok, finals, master
 
-    return step(a_all, e_all, s, r, g_table, h_table, rho)
+    return step(a, e, s, r, g_table, h_table, rho)
 
 
 def sharded_ceremony(
@@ -182,13 +215,13 @@ def sharded_ceremony(
     recomputable.  jit-compiled over the mesh; the driver's
     ``dryrun_multichip`` runs this on a virtual CPU mesh.
     """
-    a_all, e_all, s, r = sharded_deal(cfg, mesh, coeffs_a, coeffs_b, g_table, h_table)
-    jax.block_until_ready(e_all)
+    a, e, s, r = sharded_deal(cfg, mesh, coeffs_a, coeffs_b, g_table, h_table)
+    jax.block_until_ready(e)
     # multihost-safe: only 32-byte row digests cross process boundaries
-    digest = ce.sharded_transcript_digest(cfg, a_all, e_all, s, r)
+    digest = ce.sharded_transcript_digest(cfg, a, e, s, r)
     rho = jnp.asarray(ce.fiat_shamir_rho(cfg, digest, rho_bits))
     return sharded_verify_finalise(
-        cfg, mesh, a_all, e_all, s, r, g_table, h_table, rho, rho_bits
+        cfg, mesh, a, e, s, r, g_table, h_table, rho, rho_bits
     )
 
 
@@ -199,16 +232,16 @@ def _check_mesh(cfg: ce.CeremonyConfig, mesh: Mesh) -> int:
     return n_dev
 
 
-def _verify_block(cfg, e_all, s_recv, r_recv, rho, rho_bits, g_table, h_table, first, block):
+def _verify_block(cfg, d_comm, s_recv, r_recv, rho, rho_bits, g_table, h_table, first, block):
     """RLC batch verification for a block of recipients [first, first+block).
 
     Same equations as ce.verify_batch but with shard-local recipient
-    indices (the D_l point-RLC is over *all* dealers, gathered)."""
+    indices; the combined commitment columns ``d_comm`` (t+1, C, L) are
+    supplied by the caller (assembled from per-shard partial RLCs)."""
     cs = cfg.cs
     fs = cs.scalar
     s_rlc = ce._field_dot(fs, rho, s_recv)  # (block, L)
     r_rlc = ce._field_dot(fs, rho, r_recv)
-    d_comm = ce._point_rlc(cs, rho, e_all, rho_bits)  # (t+1, C, L)
     xs = first + jnp.arange(block, dtype=jnp.uint32)
     rhs = gd.eval_point_poly(cs, d_comm, xs, cfg.index_bits)
     lhs = gd.add(

@@ -45,6 +45,8 @@ def test_device_tree_matches_python_twin(words):
     dev = np.asarray(dh.tree_digest(jnp.asarray(vals, jnp.uint32), domain=7))
     ref = dh.tree_digest_host(vals, domain=7)
     assert [int(x) for x in dev] == ref
+    # byte serialisation (external-verifier convenience) agrees too
+    assert dh.digest_to_bytes(dev) == dh.digest_to_bytes(ref)
 
 
 def test_row_digests_are_independent_rows():

@@ -42,22 +42,24 @@ def test_sharded_matches_single_device():
 
 
 def test_sharded_deal_matches_single_device_transcript():
-    """The sharded round-1 transcript (gathered commitments + share
-    matrices) is bit-identical to the single-device one, so both derive
-    the same Fiat-Shamir randomizers."""
+    """The sharded round-1 output (all four tensors dealer-sharded — the
+    commitments are deliberately never replicated) is bit-identical to
+    the single-device one, so both derive the same Fiat-Shamir
+    randomizers."""
     n, t = 8, 3
     c = ce.BatchedCeremony("ristretto255", n, t, b"sharded-tr", RNG)
     a, e, s, r = ce.deal(c.cfg, c.coeffs_a, c.coeffs_b, c.g_table, c.h_table)
     mesh = pm.make_mesh(8)
-    a_all, e_all, s_sh, r_sh = pm.sharded_deal(
+    a_sh, e_sh, s_sh, r_sh = pm.sharded_deal(
         c.cfg, mesh, c.coeffs_a, c.coeffs_b, c.g_table, c.h_table
     )
-    np.testing.assert_array_equal(np.asarray(e_all), np.asarray(e))
+    np.testing.assert_array_equal(np.asarray(e_sh), np.asarray(e))
+    np.testing.assert_array_equal(np.asarray(a_sh), np.asarray(a))
     np.testing.assert_array_equal(np.asarray(s_sh), np.asarray(s))
     # the shard-folded digest equals the flat canonical (device) digest
     # bit-for-bit — sharded and single-chip engines derive the same rho
     assert ce.sharded_transcript_digest(
-        c.cfg, a_all, e_all, s_sh, r_sh
+        c.cfg, a_sh, e_sh, s_sh, r_sh
     ) == ce.transcript_digest_device(c.cfg, a, e, s, r)
 
 
