@@ -131,6 +131,7 @@ def sharded_verify_finalise(
     h_table: jax.Array,
     rho: jax.Array,  # (n, L) replicated Fiat-Shamir randomizers
     rho_bits: int,
+    qualified: jax.Array | None = None,  # (n,) replicated dealer mask
 ):
     """Round 2 + finalise over the mesh, commitments never replicated.
 
@@ -153,14 +154,20 @@ def sharded_verify_finalise(
     """
     n_dev = _check_mesh(cfg, mesh)
     cs = cfg.cs
+    if qualified is None:
+        qualified = jnp.ones((cfg.n,), bool)
 
     @functools.partial(
         _shard_map_nocheck,
         mesh=mesh,
-        in_specs=(P(PARTY_AXIS), P(PARTY_AXIS), P(PARTY_AXIS), P(PARTY_AXIS), P(), P(), P()),
+        in_specs=(P(PARTY_AXIS), P(PARTY_AXIS), P(PARTY_AXIS), P(PARTY_AXIS), P(), P(), P(), P()),
         out_specs=(P(PARTY_AXIS), P(PARTY_AXIS), P()),
     )
-    def step(a_sh, e_sh, s_sh, r_sh, gt, ht, rho_all):
+    def step(a_sh, e_sh, s_sh, r_sh, gt, ht, rho_all, qual):
+        # disqualified dealers contribute NOTHING to the batch check:
+        # zero rho weights drop their shares from the scalar RLCs and
+        # their commitment columns from D_l consistently
+        rho_used = jnp.where(qual[:, None], rho_all, jnp.zeros_like(rho_all))
         # --- share delivery: dealer-sharded -> recipient-sharded
         s_recv = lax.all_to_all(s_sh, PARTY_AXIS, split_axis=1, concat_axis=0, tiled=True)
         r_recv = lax.all_to_all(r_sh, PARTY_AXIS, split_axis=1, concat_axis=0, tiled=True)
@@ -170,31 +177,100 @@ def sharded_verify_finalise(
         # --- combined commitment columns: partial RLC over local dealers,
         # then gather + tree-add the ndev partials (point sum, NOT psum:
         # limbs don't add elementwise)
-        rho_local = lax.dynamic_slice_in_dim(rho_all, shard * block, block, 0)
+        rho_local = lax.dynamic_slice_in_dim(rho_used, shard * block, block, 0)
         d_part = ce._point_rlc(cs, rho_local, e_sh, rho_bits)  # (t+1, C, L)
         d_all = lax.all_gather(d_part, PARTY_AXIS)  # (ndev, t+1, C, L)
         d_comm = gd._tree_reduce(cs, jnp.moveaxis(d_all, 0, -3), n_dev)
         # --- round 2: RLC batch verification of the local recipient block
         ok = _verify_block(
-            cfg, d_comm, s_recv, r_recv, rho_all, rho_bits, gt, ht, first, block
+            cfg, d_comm, s_recv, r_recv, rho_used, rho_bits, gt, ht, first, block
         )
-        # --- aggregation + master key (all dealers qualified: happy path)
-        qualified = jnp.ones((cfg.n,), bool)
-        finals = ce.aggregate_shares(cfg, s_recv, qualified)
-        # mask the shard's bare A_{j,0} by ITS slice of the qualified
-        # set before reducing — same semantics as the single-device
-        # master_key_from_bare, so wiring a real qualified mask in later
-        # cannot diverge from the aggregated shares
-        q_local = lax.dynamic_slice_in_dim(qualified, shard * block, block, 0)
-        a0 = gd.select(
-            q_local, a_sh[:, 0], gd.identity(cs, (block,))
+        finals, master = _finalise_shardlocal(
+            cfg, n_dev, a_sh, s_recv, qual, shard, block
         )
-        m_part = gd._tree_reduce(cs, a0, block)  # (C, L)
-        m_all = lax.all_gather(m_part, PARTY_AXIS)  # (ndev, C, L)
-        master = gd._tree_reduce(cs, m_all, n_dev)
         return ok, finals, master
 
-    return step(a, e, s, r, g_table, h_table, rho)
+    return step(a, e, s, r, g_table, h_table, rho, qualified)
+
+
+def _finalise_shardlocal(cfg, n_dev, a_sh, s_recv, qual, shard, block):
+    """Aggregation + master key inside a shard_map body.
+
+    Masks the shard's bare A_{j,0} by ITS slice of the qualified set
+    before reducing — same semantics as the single-device
+    master_key_from_bare, so the master key and the aggregated shares
+    always cover the same dealer set.
+    """
+    cs = cfg.cs
+    finals = ce.aggregate_shares(cfg, s_recv, qual)
+    q_local = lax.dynamic_slice_in_dim(qual, shard * block, block, 0)
+    a0 = gd.select(q_local, a_sh[:, 0], gd.identity(cs, (block,)))
+    m_part = gd._tree_reduce(cs, a0, block)  # (C, L)
+    m_all = lax.all_gather(m_part, PARTY_AXIS)  # (ndev, C, L)
+    master = gd._tree_reduce(cs, m_all, n_dev)
+    return finals, master
+
+
+def sharded_finalise(
+    cfg: ce.CeremonyConfig,
+    mesh: Mesh,
+    a: jax.Array,  # (n, t+1, C, L) dealer-sharded
+    s: jax.Array,  # (n, n, L) dealer-sharded
+    qualified: jax.Array,  # (n,) replicated dealer mask
+):
+    """Aggregation + master key only, over an adjudicated qualified set
+    (the blame path re-finalise: no verification work — the pairwise
+    checks already determined exactly which dealers are out)."""
+    n_dev = _check_mesh(cfg, mesh)
+
+    @functools.partial(
+        _shard_map_nocheck,
+        mesh=mesh,
+        in_specs=(P(PARTY_AXIS), P(PARTY_AXIS), P()),
+        out_specs=(P(PARTY_AXIS), P()),
+    )
+    def step(a_sh, s_sh, qual):
+        s_recv = lax.all_to_all(s_sh, PARTY_AXIS, split_axis=1, concat_axis=0, tiled=True)
+        shard = lax.axis_index(PARTY_AXIS)
+        block = cfg.n // n_dev
+        return _finalise_shardlocal(cfg, n_dev, a_sh, s_recv, qual, shard, block)
+
+    return step(a, s, qualified)
+
+
+def sharded_blame(
+    cfg: ce.CeremonyConfig,
+    mesh: Mesh,
+    e: jax.Array,  # (n, t+1, C, L) dealer-sharded
+    s: jax.Array,  # (n, n, L) dealer-sharded
+    r: jax.Array,
+    g_table: jax.Array,
+    h_table: jax.Array,
+):
+    """Pairwise blame assignment on the mesh -> replicated (n, n) bools.
+
+    The per-pair check g*s_ji + h*s'_ji == sum_l x_i^l E_{j,l} reads
+    ONLY dealer-local data (each shard holds its dealers' commitments
+    AND the share rows they dealt), so blame needs zero share movement:
+    every shard re-checks its own dealers against all n recipients and
+    one bool allgather assembles the verdict matrix (the mesh twin of
+    ceremony.verify_pairwise / the reference complaint trigger,
+    committee.rs:305-317).  Rare-path cost: O(n * n/ndev) fixed-base
+    mults per shard.
+    """
+    _check_mesh(cfg, mesh)
+
+    @functools.partial(
+        _shard_map_nocheck,
+        mesh=mesh,
+        in_specs=(P(PARTY_AXIS), P(PARTY_AXIS), P(PARTY_AXIS), P(), P()),
+        out_specs=P(),
+    )
+    def step(e_sh, s_sh, r_sh, gt, ht):
+        pw = ce.verify_pairwise(cfg, e_sh, s_sh, r_sh, gt, ht)  # (block, n)
+        return lax.all_gather(pw, PARTY_AXIS, tiled=True)  # (n, n)
+
+    return step(e, s, r, g_table, h_table)
 
 
 def sharded_ceremony(
@@ -205,24 +281,65 @@ def sharded_ceremony(
     g_table: jax.Array,
     h_table: jax.Array,
     rho_bits: int = 128,
+    tamper=None,
 ):
-    """Full happy-path ceremony, parties sharded over the mesh.
+    """Full ceremony, parties sharded over the mesh — blame included.
 
     Two device phases with a host Fiat-Shamir boundary between them —
     rho is derived from the digest of the COMPLETE round-1 transcript
     (commitments + delivered shares), never from a fixed string, so the
     batch check is sound against an adaptive dealer and publicly
-    recomputable.  jit-compiled over the mesh; the driver's
-    ``dryrun_multichip`` runs this on a virtual CPU mesh.
+    recomputable.  If the batch check fails anywhere, the engine drops
+    to ``sharded_blame``, disqualifies guilty dealers, and re-finalises
+    over the qualified set with ``sharded_finalise`` (aggregation +
+    master key only — the pairwise checks already adjudicated, so no
+    verification is repeated), mirroring BatchedCeremony.run's flow.
+
+    Returns (ok, finals, master, qualified): ``ok`` is the
+    PRE-adjudication per-recipient batch check (failures show which
+    recipients received bad shares); ``qualified`` the final dealer
+    mask.  Raises ``DkgError(MISBEHAVIOUR_HIGHER_THRESHOLD)`` when more
+    than t dealers are disqualified (committee.rs:340-347 — the tuple
+    API has no error slot, and proceeding would yield a key backed by
+    fewer than t+1 honest dealers).  ``tamper(a, e, s, r) -> same`` is
+    the fault-injection hook (arrays must keep their shardings);
+    jit-compiled over the mesh; the driver's ``dryrun_multichip`` runs
+    this on a virtual CPU mesh.
     """
+    from ..dkg.errors import DkgError, DkgErrorKind
+
     a, e, s, r = sharded_deal(cfg, mesh, coeffs_a, coeffs_b, g_table, h_table)
+    if tamper is not None:
+        a, e, s, r = tamper(a, e, s, r)
     jax.block_until_ready(e)
     # multihost-safe: only 32-byte row digests cross process boundaries
     digest = ce.sharded_transcript_digest(cfg, a, e, s, r)
     rho = jnp.asarray(ce.fiat_shamir_rho(cfg, digest, rho_bits))
-    return sharded_verify_finalise(
+    ok, finals, master = sharded_verify_finalise(
         cfg, mesh, a, e, s, r, g_table, h_table, rho, rho_bits
     )
+    qualified = jnp.ones((cfg.n,), bool)
+    if not bool(_host_global(ok).all()):
+        # pw is replicated (out_specs P()), so plain asarray is
+        # multihost-safe: every process holds a full copy
+        pw = np.asarray(sharded_blame(cfg, mesh, e, s, r, g_table, h_table))
+        guilty = ~pw.all(axis=1)
+        if int(guilty.sum()) > cfg.t:
+            raise DkgError(DkgErrorKind.MISBEHAVIOUR_HIGHER_THRESHOLD)
+        qualified = jnp.asarray(~guilty)
+        finals, master = sharded_finalise(cfg, mesh, a, s, qualified)
+    return ok, finals, master, qualified
+
+
+def _host_global(x: jax.Array) -> np.ndarray:
+    """Global host value of a possibly mesh-sharded array; on multi-host
+    meshes the shards are gathered across processes first (a direct
+    np.asarray would fail: the array spans non-addressable devices)."""
+    if jax.process_count() > 1:  # pragma: no cover — single-process CI
+        from jax.experimental import multihost_utils as mhu
+
+        return np.asarray(mhu.process_allgather(x, tiled=True))
+    return np.asarray(x)
 
 
 def _check_mesh(cfg: ce.CeremonyConfig, mesh: Mesh) -> int:

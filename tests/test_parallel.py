@@ -30,12 +30,13 @@ def test_sharded_matches_single_device():
     master_ref = ce.master_key_from_bare(c.cfg, a, jnp.ones((n,), bool))
 
     mesh = pm.make_mesh(8)
-    ok, finals, master = pm.sharded_ceremony(
+    ok, finals, master, qualified = pm.sharded_ceremony(
         c.cfg, mesh, c.coeffs_a, c.coeffs_b, c.g_table, c.h_table, rho_bits=rho_bits
     )
 
     assert np.asarray(ok).all()
     assert np.asarray(ok_ref).all()
+    assert np.asarray(qualified).all()
     # bit-exact parity between sharded and single-device paths
     np.testing.assert_array_equal(np.asarray(finals), np.asarray(finals_ref))
     np.testing.assert_array_equal(np.asarray(master), np.asarray(master_ref))
@@ -87,3 +88,75 @@ def test_multihost_helpers_single_process():
     assert m.devices.size == len(jax.devices())
     start, stop = multihost.process_party_block(16)
     assert (start, stop) == (0, 16)
+
+
+def test_sharded_blame_disqualifies_cheating_dealer():
+    """An injected cheat on the mesh drops the ceremony into
+    sharded_blame: the guilty dealer is disqualified on every shard and
+    the re-finalised results equal the single-device engine's blame-path
+    results over the same qualified set."""
+    from dkg_tpu.fields import host as fh
+
+    n, t = 8, 3
+    c = ce.BatchedCeremony("ristretto255", n, t, b"sharded-blame", RNG)
+    fs = c.cfg.cs.scalar
+
+    def corrupt(s_np):
+        bad = np.asarray(s_np).copy()
+        # dealer 3 (index 2) deals garbage to recipients 2 and 7
+        for i in (1, 6):
+            bad[2, i] = fh.encode(fs, (fh.decode_int(fs, bad[2, i]) + 5) % fs.modulus)
+        return bad
+
+    # single-device reference with the same corruption
+    out_ref = c.run(rho_bits=64, tamper=lambda a, e, s, r: (a, e, jnp.asarray(corrupt(s)), r))
+    assert out_ref["complaints"] == [(2, 3), (7, 3)]
+
+    def tamper(a, e, s, r):
+        bad = jax.device_put(corrupt(np.asarray(s)), s.sharding)
+        return a, e, bad, r
+
+    mesh = pm.make_mesh(8)
+    ok, finals, master, qualified = pm.sharded_ceremony(
+        c.cfg, mesh, c.coeffs_a, c.coeffs_b, c.g_table, c.h_table,
+        rho_bits=64, tamper=tamper,
+    )
+    assert np.asarray(qualified).tolist() == [
+        True, True, False, True, True, True, True, True,
+    ]
+    # pre-adjudication check: exactly the victim recipients failed
+    assert np.asarray(ok).tolist() == [
+        True, False, True, True, True, True, False, True,
+    ]
+    np.testing.assert_array_equal(
+        np.asarray(finals), np.asarray(out_ref["final_shares"])
+    )
+    np.testing.assert_array_equal(np.asarray(master), np.asarray(out_ref["master"]))
+
+
+def test_sharded_ceremony_aborts_past_threshold():
+    """More than t cheating dealers raises MISBEHAVIOUR_HIGHER_THRESHOLD
+    (committee.rs:340-347) instead of finalising a key backed by fewer
+    than t+1 honest dealers."""
+    import pytest
+
+    from dkg_tpu.fields import host as fh
+    from dkg_tpu.dkg.errors import DkgError, DkgErrorKind
+
+    n, t = 8, 2
+    c = ce.BatchedCeremony("ristretto255", n, t, b"sharded-abort", RNG)
+    fs = c.cfg.cs.scalar
+
+    def tamper(a, e, s, r):
+        bad = np.asarray(s).copy()
+        for j in (0, 3, 5):  # 3 cheating dealers > t=2
+            bad[j, 1] = fh.encode(fs, (fh.decode_int(fs, bad[j, 1]) + 1) % fs.modulus)
+        return a, e, jax.device_put(bad, s.sharding), r
+
+    mesh = pm.make_mesh(8)
+    with pytest.raises(DkgError) as exc:
+        pm.sharded_ceremony(
+            c.cfg, mesh, c.coeffs_a, c.coeffs_b, c.g_table, c.h_table,
+            rho_bits=64, tamper=tamper,
+        )
+    assert exc.value.kind == DkgErrorKind.MISBEHAVIOUR_HIGHER_THRESHOLD
