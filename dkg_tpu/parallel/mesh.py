@@ -131,7 +131,6 @@ def sharded_verify_finalise(
     h_table: jax.Array,
     rho: jax.Array,  # (n, L) replicated Fiat-Shamir randomizers
     rho_bits: int,
-    qualified: jax.Array | None = None,  # (n,) replicated dealer mask
 ):
     """Round 2 + finalise over the mesh, commitments never replicated.
 
@@ -154,20 +153,14 @@ def sharded_verify_finalise(
     """
     n_dev = _check_mesh(cfg, mesh)
     cs = cfg.cs
-    if qualified is None:
-        qualified = jnp.ones((cfg.n,), bool)
 
     @functools.partial(
         _shard_map_nocheck,
         mesh=mesh,
-        in_specs=(P(PARTY_AXIS), P(PARTY_AXIS), P(PARTY_AXIS), P(PARTY_AXIS), P(), P(), P(), P()),
+        in_specs=(P(PARTY_AXIS), P(PARTY_AXIS), P(PARTY_AXIS), P(PARTY_AXIS), P(), P(), P()),
         out_specs=(P(PARTY_AXIS), P(PARTY_AXIS), P()),
     )
-    def step(a_sh, e_sh, s_sh, r_sh, gt, ht, rho_all, qual):
-        # disqualified dealers contribute NOTHING to the batch check:
-        # zero rho weights drop their shares from the scalar RLCs and
-        # their commitment columns from D_l consistently
-        rho_used = jnp.where(qual[:, None], rho_all, jnp.zeros_like(rho_all))
+    def step(a_sh, e_sh, s_sh, r_sh, gt, ht, rho_all):
         # --- share delivery: dealer-sharded -> recipient-sharded
         s_recv = lax.all_to_all(s_sh, PARTY_AXIS, split_axis=1, concat_axis=0, tiled=True)
         r_recv = lax.all_to_all(r_sh, PARTY_AXIS, split_axis=1, concat_axis=0, tiled=True)
@@ -177,20 +170,21 @@ def sharded_verify_finalise(
         # --- combined commitment columns: partial RLC over local dealers,
         # then gather + tree-add the ndev partials (point sum, NOT psum:
         # limbs don't add elementwise)
-        rho_local = lax.dynamic_slice_in_dim(rho_used, shard * block, block, 0)
+        rho_local = lax.dynamic_slice_in_dim(rho_all, shard * block, block, 0)
         d_part = ce._point_rlc(cs, rho_local, e_sh, rho_bits)  # (t+1, C, L)
         d_all = lax.all_gather(d_part, PARTY_AXIS)  # (ndev, t+1, C, L)
         d_comm = gd._tree_reduce(cs, jnp.moveaxis(d_all, 0, -3), n_dev)
         # --- round 2: RLC batch verification of the local recipient block
         ok = _verify_block(
-            cfg, d_comm, s_recv, r_recv, rho_used, rho_bits, gt, ht, first, block
+            cfg, d_comm, s_recv, r_recv, rho_all, rho_bits, gt, ht, first, block
         )
+        qual = jnp.ones((cfg.n,), bool)  # blame re-finalises separately
         finals, master = _finalise_shardlocal(
             cfg, n_dev, a_sh, s_recv, qual, shard, block
         )
         return ok, finals, master
 
-    return step(a, e, s, r, g_table, h_table, rho, qualified)
+    return step(a, e, s, r, g_table, h_table, rho)
 
 
 def _finalise_shardlocal(cfg, n_dev, a_sh, s_recv, qual, shard, block):
@@ -325,7 +319,11 @@ def sharded_ceremony(
         pw = np.asarray(sharded_blame(cfg, mesh, e, s, r, g_table, h_table))
         guilty = ~pw.all(axis=1)
         if int(guilty.sum()) > cfg.t:
-            raise DkgError(DkgErrorKind.MISBEHAVIOUR_HIGHER_THRESHOLD)
+            raise DkgError(
+                DkgErrorKind.MISBEHAVIOUR_HIGHER_THRESHOLD,
+                detail="guilty dealers (1-based): "
+                + ", ".join(str(j + 1) for j in np.nonzero(guilty)[0]),
+            )
         qualified = jnp.asarray(~guilty)
         finals, master = sharded_finalise(cfg, mesh, a, s, qualified)
     return ok, finals, master, qualified
