@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Two-process multi-host smoke of the sharded ceremony (DCN analogue).
+
+Parent mode (no args): spawns two child processes, each a jax
+"host" with 4 virtual CPU devices, joined via
+``jax.distributed.initialize`` — the same global-mesh program that runs
+across real TPU hosts over DCN.  Children run the full sharded ceremony
+(n=16 over the 8-device global mesh) and print their master key; the
+parent asserts both agree and exits 0.
+
+Child mode: ``multihost_smoke.py <process_id> <coordinator>``.
+
+This exercises the multi-process branches the single-process suite
+cannot reach: cross-process collectives under shard_map, the
+``process_allgather`` row-digest fold in sharded_transcript_digest, and
+the _host_global gather of the recipient-sharded ok mask.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+N_PROCS = 2
+LOCAL_DEVICES = 4
+
+
+def child(pid: int, coordinator: str) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=coordinator, num_processes=N_PROCS, process_id=pid
+    )
+    import random
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dkg_tpu.dkg import ceremony as ce
+    from dkg_tpu.parallel import mesh as pm
+
+    assert jax.process_count() == N_PROCS
+    assert len(jax.devices()) == N_PROCS * LOCAL_DEVICES, jax.devices()
+
+    n, t = 16, 5
+    c = ce.BatchedCeremony("ristretto255", n, t, b"multihost-smoke", random.Random(3))
+    mesh = pm.make_mesh(N_PROCS * LOCAL_DEVICES)
+
+    # In multihost, shard_map inputs must be GLOBAL arrays; every process
+    # holds the same host value (same seed), so build them shard-by-shard.
+    from jax.sharding import NamedSharding
+
+    def to_global(x, spec):
+        x = np.asarray(x)
+        return jax.make_array_from_callback(
+            x.shape, NamedSharding(mesh, spec), lambda idx: x[idx]
+        )
+
+    party = pm.P(pm.PARTY_AXIS)
+    repl = pm.P()
+    ok, finals, master, qualified = pm.sharded_ceremony(
+        c.cfg,
+        mesh,
+        to_global(c.coeffs_a, party),
+        to_global(c.coeffs_b, party),
+        to_global(c.g_table, repl),
+        to_global(c.h_table, repl),
+        rho_bits=64,
+    )
+    assert bool(np.asarray(pm._host_global(ok)).all())
+    assert bool(np.asarray(qualified).all())
+    master_np = np.asarray(master)  # replicated: every process holds it
+    import hashlib
+
+    digest = hashlib.sha256(np.ascontiguousarray(master_np).tobytes()).hexdigest()
+    print(f"[child {pid}] master: {digest}", flush=True)
+    print(f"[child {pid}] OK", flush=True)
+
+
+def main() -> int:
+    if len(sys.argv) == 3:
+        child(int(sys.argv[1]), sys.argv[2])
+        return 0
+    # ephemeral coordinator port: concurrent/back-to-back runs must not
+    # collide on a fixed bind address
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coordinator = f"127.0.0.1:{s.getsockname()[1]}"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    # replace (never append to) inherited XLA_FLAGS: a parent device-count
+    # flag would fight this one and the winner is parser-order luck
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={LOCAL_DEVICES}"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), str(pid), coordinator],
+            env=env,
+            cwd=REPO,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(N_PROCS)
+    ]
+    t0 = time.time()
+    deadline = t0 + 2100  # ONE shared budget, not per-child
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=max(1.0, deadline - time.time()))
+            outs.append(out)
+    finally:
+        # a hung/failed child must not orphan its sibling (it would pin
+        # the 1-core box and hold the coordinator connection)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        tail = "\n".join(out.strip().splitlines()[-12:])
+        print(f"--- child {pid} (rc={p.returncode}) ---\n{tail}")
+    if len(outs) < len(procs) or any(p.returncode != 0 for p in procs):
+        return 1
+    masters = [
+        next(line for line in out.splitlines() if "master:" in line).split("master:")[1]
+        for out in outs
+    ]
+    assert masters[0] == masters[1], "processes disagree on the master key"
+    print(f"multihost smoke OK in {time.time()-t0:.0f}s; masters agree")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

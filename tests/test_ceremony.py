@@ -273,3 +273,35 @@ def test_run_aborts_when_cheaters_exceed_threshold():
 
     assert out["error"].kind == DkgErrorKind.MISBEHAVIOUR_HIGHER_THRESHOLD
     assert np.asarray(out["qualified"]).sum() == 5
+
+
+def test_run_blame_identifies_random_tamper_patterns():
+    """Property-style: for several random tamper patterns, the blame
+    path disqualifies EXACTLY the tampered dealers and records exactly
+    the (victim, dealer) complaint pairs."""
+    c = ce.BatchedCeremony("ristretto255", 8, 3, b"blame-prop", random.Random(11))
+    fs = c.cfg.cs.scalar
+    prop_rng = random.Random(0x9909)
+    for trial in range(3):
+        dealers = sorted(prop_rng.sample(range(8), prop_rng.randint(1, 3)))
+        pairs = sorted(
+            (j, i)
+            for j in dealers
+            for i in prop_rng.sample(range(8), prop_rng.randint(1, 2))
+        )
+
+        def cheat(a, e, s, r, pairs=pairs):
+            bad = np.asarray(s).copy()
+            for j, i in pairs:
+                bad[j, i] = fh.encode(
+                    fs, (fh.decode_int(fs, bad[j, i]) + 3) % fs.modulus
+                )
+            return a, e, jnp.asarray(bad), r
+
+        out = c.run(rho_bits=64, tamper=cheat)
+        assert "error" not in out, (trial, pairs)
+        assert sorted(out["complaints"]) == sorted(
+            (i + 1, j + 1) for j, i in pairs
+        ), (trial, pairs)
+        expect_qualified = [j not in dealers for j in range(8)]
+        assert np.asarray(out["qualified"]).tolist() == expect_qualified, trial

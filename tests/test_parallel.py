@@ -5,6 +5,7 @@ multichip dryrun)."""
 import random
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -160,3 +161,22 @@ def test_sharded_ceremony_aborts_past_threshold():
             rho_bits=64, tamper=tamper,
         )
     assert exc.value.kind == DkgErrorKind.MISBEHAVIOUR_HIGHER_THRESHOLD
+
+
+@pytest.mark.slow
+def test_multihost_two_process_smoke():
+    """Two REAL jax processes (gloo collectives) run the sharded
+    ceremony over a global mesh and agree on the master key — the DCN
+    branches (process_allgather digest fold, _host_global) execute for
+    real.  Slow tier: spawns subprocesses, ~5 min on this box."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    rc = subprocess.call(
+        [sys.executable, str(repo / "scripts" / "multihost_smoke.py")],
+        cwd=repo,
+        timeout=2400,
+    )
+    assert rc == 0
