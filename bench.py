@@ -142,14 +142,31 @@ def main():
     jax.config.update("jax_compilation_cache_dir", "/tmp/dkg_tpu_jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     platform = jax.devices()[0].platform
-    # (curve, n, t): north-star curve; size chosen per platform so the
-    # bench finishes promptly.  BASELINE.json config #3 shape on TPU.
+    # (curve, n, t, extra-env): north-star curve; size per platform so
+    # the bench finishes promptly (BASELINE.json config #3 shape on
+    # TPU).  The second TPU rung retries the SAME size with the new
+    # fast-path features disabled (MXU int8 matmul, 16-bit-window
+    # device tables) — insurance so a lowering failure in a new default
+    # degrades the measured rate instead of zeroing the bench.
+    conservative = {"DKG_TPU_MXU": "0", "DKG_TPU_FB_WINDOW": "8"}
     if platform == "tpu":
-        ladder = [("secp256k1", 1024, 341), ("secp256k1", 256, 85)]
+        ladder = [
+            ("secp256k1", 1024, 341, {}),
+            ("secp256k1", 1024, 341, conservative),
+            ("secp256k1", 256, 85, conservative),
+        ]
     else:
-        ladder = [("secp256k1", 64, 21)]
+        ladder = [("secp256k1", 64, 21, {})]
 
-    for curve, n, t in ladder:
+    for curve, n, t, extra_env in ladder:
+        os.environ.update(extra_env)
+        if extra_env:
+            # free the default rung's residue before a conservative
+            # retry: the ~200MB-per-base window-16 device tables are
+            # pinned by their cache and would defeat an OOM fallback
+            from dkg_tpu.groups import device as gd
+
+            gd._fixed_table_dev_cached.cache_clear()
         try:
             t_deal, t_verify, t_rho = run(curve, n, t)
             pairs = n * (n - 1)
@@ -175,6 +192,7 @@ def main():
                             "verify_s": round(t_verify, 3),
                             "fiat_shamir_s": round(t_rho, 3),
                             "pallas": _pallas_active(),
+                            "flags": extra_env,  # {} == defaults
                             "tpu_cpu_bit_exact": parity,
                         },
                     }
