@@ -104,6 +104,87 @@ def parity_check(curve: str = "secp256k1", n: int = 64, t: int = 21) -> bool:
     return all(bool((x == y).all()) for x, y in zip(tpu_out, cpu_out))
 
 
+def _north_star_child(n_ns: int, t_ns: int) -> None:
+    """Measure one north-star-shape ceremony and print its JSON line.
+
+    Runs in a CHILD process (see north_star_rung) so a stalled compile
+    or wedged tunnel costs this attempt its timeout, never the bench
+    artifact — the same isolation discipline as _accelerator_usable.
+    """
+    import time as _time
+
+    from dkg_tpu.dkg import ceremony as ce
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/dkg_tpu_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    rng = random.Random(0x4096)
+    c = ce.BatchedCeremony("secp256k1", n_ns, t_ns, b"north-star", rng)
+    t0 = _time.perf_counter()
+    out = c.run(rho_bits=128)
+    sync(out["master"])
+    assert bool(jnp.asarray(out["ok"]).all())
+    cold = _time.perf_counter() - t0
+    # warm run: compiles amortise over the ceremony in production
+    t0 = _time.perf_counter()
+    out = c.run(rho_bits=128)
+    sync(out["master"])
+    warm = _time.perf_counter() - t0
+    scale = (4096 / n_ns) ** 2  # pair count dominates
+    print(
+        json.dumps(
+            {
+                "curve": "secp256k1",
+                "n": n_ns,
+                "t": t_ns,
+                "ceremony_s": round(warm, 3),
+                "cold_s": round(cold, 3),
+                "extrapolated_n4096_s": round(warm * scale, 3),
+                "single_chip_budget_s": 80.0,
+                "on_budget": bool(warm * scale < 80.0),
+            }
+        )
+    )
+
+
+def north_star_rung():
+    """Whole-ceremony wall-clock at the north-star shape (BASELINE.json:
+    secp256k1, n=4096, t=1365, <10 s on a v5e-8 => 80 s single-chip
+    budget at the mesh layout's linear party-axis scaling).
+
+    Each size attempt runs in a subprocess under a HARD timeout (the
+    only honest time-box: in-process estimates cannot bound a stalled
+    remote compile).  Smaller n keeps the t=1365 cost structure; the
+    n=4096 extrapolation is reported explicitly.  Returns a dict for
+    the JSON line's ``north_star`` slot.
+    """
+    import subprocess
+
+    t_ns = 1365
+    for n_ns, timeout_s in ((4096, 540.0), (2048, 360.0), (1024, 300.0)):
+        try:
+            r = subprocess.run(
+                [
+                    sys.executable,
+                    "-c",
+                    "import bench; bench._north_star_child(%d, %d)" % (n_ns, t_ns),
+                ],
+                timeout=timeout_s,
+                capture_output=True,
+                text=True,
+                cwd=str(__import__("pathlib").Path(__file__).parent),
+            )
+            if r.returncode == 0 and r.stdout.strip():
+                return json.loads(r.stdout.strip().splitlines()[-1])
+            print(
+                f"north-star rung n={n_ns} rc={r.returncode}: "
+                + r.stderr.strip()[-200:],
+                file=sys.stderr,
+            )
+        except Exception as exc:  # noqa: BLE001 — timeout: shrink and retry
+            print(f"north-star rung n={n_ns}: {exc}", file=sys.stderr)
+    return {"error": "all north-star rungs failed"}
+
+
 def run(curve: str, n: int, t: int, rho_bits: int = 128):
     from dkg_tpu.dkg import ceremony as ce
 
@@ -130,18 +211,93 @@ def run(curve: str, n: int, t: int, rho_bits: int = 128):
     return t_deal, t_verify, t_rho
 
 
-def main():
+def _accelerator_usable(timeout_s: float = 300.0) -> bool:
+    """Probe accelerator backend init in a SUBPROCESS with a timeout.
+
+    A dead tunnel has two failure modes, and only one raises: a
+    responsive-but-down plugin raises Unavailable quickly, while a
+    WEDGED tunnel hangs ``jax.devices()`` forever (observed live this
+    round).  An in-process try/except cannot survive the second mode;
+    a killable child probes both.
+    """
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return r.returncode == 0
+    except Exception:  # noqa: BLE001 — timeout/spawn failure == unusable
+        return False
+
+
+def _init_platform() -> str | None:
+    """Initialise a backend, surviving a dead or wedged TPU tunnel.
+
+    Returns the platform name, or None if not even the CPU backend could
+    come up.  A dead accelerator plugin must degrade to a CPU measurement
+    line, never to an unparseable crash (round-2 lesson: one raised
+    ``jax.devices()`` cost the whole round's perf artifact) or a hang
+    (the wedged-tunnel mode _accelerator_usable explains).
+    """
     import os
 
     # parity_check needs a CPU backend next to the TPU one; the ambient
     # env pins JAX_PLATFORMS to the tpu plugin only, so widen it BEFORE
     # the first jax touch (a platform list initialises all named backends).
     plat_env = os.environ.get("JAX_PLATFORMS")
+    accel_named = plat_env and any(p != "cpu" for p in plat_env.split(","))
+    if accel_named and not _accelerator_usable():
+        print(
+            f"accelerator backend ({plat_env}) unusable (dead/wedged tunnel); "
+            "falling back to CPU",
+            file=sys.stderr,
+        )
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+        plat_env = "cpu"
     if plat_env and "cpu" not in plat_env.split(","):
         jax.config.update("jax_platforms", plat_env + ",cpu")
+    try:
+        return jax.devices()[0].platform
+    except Exception as exc:  # noqa: BLE001 — accelerator down; retry CPU-only
+        print(f"accelerator backend init failed: {exc}", file=sys.stderr)
+    # Drop the cached failed-backend state and re-init CPU-only.
+    try:
+        try:
+            from jax.extend import backend as jex_backend
+
+            jex_backend.clear_backends()
+        except Exception:  # noqa: BLE001 — fall through to config-only retry
+            pass
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices()[0].platform
+    except Exception as exc:  # noqa: BLE001 — no backend at all
+        print(f"cpu fallback init failed: {exc}", file=sys.stderr)
+        return None
+
+
+def main():
+    import os
+
     jax.config.update("jax_compilation_cache_dir", "/tmp/dkg_tpu_jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    platform = jax.devices()[0].platform
+    platform = _init_platform()
+    if platform is None:
+        print(
+            json.dumps(
+                {
+                    "metric": "share_verify_pairs_per_sec_per_chip",
+                    "value": 0.0,
+                    "unit": "pair-verifications/s",
+                    "vs_baseline": 0.0,
+                    "config": {"platform": None, "error": "no jax backend"},
+                }
+            )
+        )
+        return
     # (curve, n, t, extra-env): north-star curve; size per platform so
     # the bench finishes promptly (BASELINE.json config #3 shape on
     # TPU).  The second TPU rung retries the SAME size with the new
@@ -159,23 +315,33 @@ def main():
         ladder = [("secp256k1", 64, 21, {})]
 
     for curve, n, t, extra_env in ladder:
-        os.environ.update(extra_env)
-        if extra_env:
-            # free the default rung's residue before a conservative
-            # retry: the ~200MB-per-base window-16 device tables are
-            # pinned by their cache and would defeat an OOM fallback
-            from dkg_tpu.groups import device as gd
-
-            gd._fixed_table_dev_cached.cache_clear()
         try:
+            os.environ.update(extra_env)
+            if extra_env:
+                # free the default rung's residue before a conservative
+                # retry: the ~200MB-per-base window-16 device tables are
+                # pinned by their cache and would defeat an OOM fallback
+                from dkg_tpu.groups import device as gd
+
+                gd._fixed_table_dev_cached.cache_clear()
             t_deal, t_verify, t_rho = run(curve, n, t)
             pairs = n * (n - 1)
             rate = pairs / t_verify
             try:
-                parity = parity_check() if platform == "tpu" else None
+                # On TPU this is the real cross-device bit-exactness bit;
+                # on CPU it still cross-checks the fused-kernel path
+                # against the independent pure-XLA formulation.
+                parity = parity_check()
             except Exception as exc:  # noqa: BLE001 — parity is reported, not fatal
                 print(f"parity check failed to run: {exc}", file=sys.stderr)
                 parity = False
+            north_star = None
+            if platform == "tpu" and os.environ.get("DKG_TPU_BENCH_NS") != "0":
+                try:
+                    north_star = north_star_rung()
+                except Exception as exc:  # noqa: BLE001 — reported, not fatal
+                    print(f"north-star rung crashed: {exc}", file=sys.stderr)
+                    north_star = {"error": str(exc)[:200]}
             print(
                 json.dumps(
                     {
@@ -194,6 +360,7 @@ def main():
                             "pallas": _pallas_active(),
                             "flags": extra_env,  # {} == defaults
                             "tpu_cpu_bit_exact": parity,
+                            "north_star": north_star,
                         },
                     }
                 )
@@ -208,6 +375,7 @@ def main():
                 "value": 0.0,
                 "unit": "pair-verifications/s",
                 "vs_baseline": 0.0,
+                "config": {"platform": platform, "error": "all ladder rungs failed"},
             }
         )
     )

@@ -68,7 +68,22 @@ def _load() -> Optional[ctypes.CDLL]:
         if not _build():
             _build_failed = True
             return None
-    lib = ctypes.CDLL(str(_LIB))
+    try:
+        return _bind(ctypes.CDLL(str(_LIB)))
+    except OSError:
+        # builds-but-won't-load (e.g. a MinGW DLL whose runtime deps are
+        # not on the DLL search path) or a stale lib missing newly
+        # required symbols: cache the failure so available() gates every
+        # use, as promised — never raise out of the optional runtime.
+        _build_failed = True
+        return None
+    except AttributeError:
+        _build_failed = True
+        return None
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    global _lib
     u64p = ctypes.POINTER(ctypes.c_uint64)
     for name, argtypes in {
         "f_add_batch": [ctypes.c_void_p, u64p, u64p, u64p, ctypes.c_size_t],
@@ -79,9 +94,17 @@ def _load() -> Optional[ctypes.CDLL]:
         "ed_scalar_mul_batch": [
             ctypes.c_void_p, u64p, ctypes.c_uint64, u64p, u64p, ctypes.c_size_t
         ],
+        "ed_scalar_mul_ct_batch": [
+            ctypes.c_void_p, u64p, ctypes.c_uint64, ctypes.c_uint64,
+            u64p, u64p, ctypes.c_size_t,
+        ],
         "ws_add_batch": [ctypes.c_void_p, u64p, u64p, u64p, ctypes.c_size_t],
         "ws_scalar_mul_batch": [
             ctypes.c_void_p, u64p, ctypes.c_uint64, u64p, u64p, ctypes.c_size_t
+        ],
+        "ws_scalar_mul_ct_batch": [
+            ctypes.c_void_p, u64p, ctypes.c_uint64, ctypes.c_uint64,
+            u64p, u64p, ctypes.c_size_t,
         ],
         "chacha20_xor": [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint32,
@@ -243,7 +266,10 @@ class NativeCurve:
         )
         return out
 
-    def scalar_mul(self, scalars, points, scalar_modulus: int):
+    def _scalar_mul_impl(self, suffix, scalars, points, scalar_modulus, extra):
+        """Shared marshalling for the vartime and constant-time ladders:
+        scalar limb encoding, point layout, and the kind-based dispatch
+        differ only by function-name suffix and the extra mid arguments."""
         lib = _load()
         sl = nlimbs64(scalar_modulus)
         ss = np.zeros((len(scalars), sl), np.uint64)
@@ -251,16 +277,31 @@ class NativeCurve:
             ss[i] = limbs64(int(s) % scalar_modulus, sl)
         points = np.ascontiguousarray(points, np.uint64)
         out = np.empty_like(points)
-        count = len(scalars)
         u64p = ctypes.POINTER(ctypes.c_uint64)
-        name = (
-            "ed_scalar_mul_batch" if self.kind == "edwards" else "ws_scalar_mul_batch"
-        )
-        getattr(lib, name)(
-            ctypes.byref(self._ctx), ss.ctypes.data_as(u64p), sl,
-            points.ctypes.data_as(u64p), out.ctypes.data_as(u64p), count,
+        prefix = "ed" if self.kind == "edwards" else "ws"
+        getattr(lib, f"{prefix}_scalar_mul{suffix}")(
+            ctypes.byref(self._ctx),
+            ss.ctypes.data_as(u64p),
+            sl,
+            *extra,
+            points.ctypes.data_as(u64p),
+            out.ctypes.data_as(u64p),
+            len(scalars),
         )
         return out
+
+    def scalar_mul(self, scalars, points, scalar_modulus: int):
+        """Variable-time ladder; PUBLIC scalars only."""
+        return self._scalar_mul_impl("_batch", scalars, points, scalar_modulus, ())
+
+    def scalar_mul_ct(self, scalars, points, scalar_modulus: int):
+        """Constant-structure ladder over the full scalar-field bit
+        length — the secret-scalar path (wire-path KEM / dealing).
+        Limb-exact match of HostGroup.scalar_mul's Python ladder."""
+        return self._scalar_mul_impl(
+            "_ct_batch", scalars, points, scalar_modulus,
+            (scalar_modulus.bit_length(),),
+        )
 
 
 def chacha20_xor(key: bytes, nonce: bytes, data: bytes, counter: int = 0) -> bytes:

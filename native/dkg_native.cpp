@@ -239,6 +239,50 @@ void ed_scalar_mul_batch(const EdCtx* c, const uint64_t* scalars,
     }
 }
 
+// Constant-structure Montgomery ladder, twisted Edwards.
+//
+// Secret-scalar path: iteration count is the caller-supplied nbits (the
+// scalar field's bit length) regardless of the value, and every
+// iteration performs exactly one cswap + one add + one double + one
+// cswap.  The swap itself is a branchless masked exchange, so neither
+// the operation sequence nor the memory-access pattern depends on the
+// scalar — unlike ed_scalar_mul_batch above (vartime, public data only).
+// Mirrors the op-for-op sequence of HostGroup.scalar_mul
+// (dkg_tpu/groups/host.py) so outputs are limb-exact identical.
+static inline void cswap_limbs(uint64_t* a, uint64_t* b, int n, uint64_t bit) {
+    const uint64_t mask = (uint64_t)0 - bit;
+    for (int i = 0; i < n; ++i) {
+        uint64_t t = mask & (a[i] ^ b[i]);
+        a[i] ^= t;
+        b[i] ^= t;
+    }
+}
+
+void ed_scalar_mul_ct_batch(const EdCtx* c, const uint64_t* scalars,
+                            uint64_t slimbs, uint64_t nbits,
+                            const uint64_t* points, uint64_t* out,
+                            size_t count) {
+    const int L = (int)c->f.nlimbs;
+    const int stride = 4 * L;
+    for (size_t k = 0; k < count; ++k) {
+        uint64_t r0[4 * MAXL], r1[4 * MAXL];
+        std::memset(r0, 0, sizeof(uint64_t) * stride);
+        r0[L] = 1;       // identity (0,1,1,0)
+        r0[2 * L] = 1;
+        std::memcpy(r1, points + k * stride, sizeof(uint64_t) * stride);
+        const uint64_t* e = scalars + k * slimbs;
+        for (int i = (int)nbits - 1; i >= 0; --i) {
+            uint64_t bit =
+                ((uint64_t)i / 64 < slimbs) ? (e[i / 64] >> (i % 64)) & 1 : 0;
+            cswap_limbs(r0, r1, stride, bit);
+            ed_add_one(c, r0, r1, r1);
+            ed_add_one(c, r0, r0, r0);
+            cswap_limbs(r0, r1, stride, bit);
+        }
+        std::memcpy(out + k * stride, r0, sizeof(uint64_t) * stride);
+    }
+}
+
 // -------------------------------------------- curve: short Weierstrass a=0
 
 struct WsCtx {
@@ -317,6 +361,33 @@ void ws_scalar_mul_batch(const WsCtx* c, const uint64_t* scalars,
                 ws_add_one(c, acc, points + k * stride, acc);
         }
         std::memcpy(out + k * stride, acc, sizeof(uint64_t) * stride);
+    }
+}
+
+// Constant-structure Montgomery ladder, short Weierstrass a=0 (see the
+// Edwards twin above for the discipline; same op-for-op mirror of
+// HostGroup.scalar_mul).
+void ws_scalar_mul_ct_batch(const WsCtx* c, const uint64_t* scalars,
+                            uint64_t slimbs, uint64_t nbits,
+                            const uint64_t* points, uint64_t* out,
+                            size_t count) {
+    const int L = (int)c->f.nlimbs;
+    const int stride = 3 * L;
+    for (size_t k = 0; k < count; ++k) {
+        uint64_t r0[3 * MAXL], r1[3 * MAXL];
+        std::memset(r0, 0, sizeof(uint64_t) * stride);
+        r0[L] = 1;  // identity (0,1,0)
+        std::memcpy(r1, points + k * stride, sizeof(uint64_t) * stride);
+        const uint64_t* e = scalars + k * slimbs;
+        for (int i = (int)nbits - 1; i >= 0; --i) {
+            uint64_t bit =
+                ((uint64_t)i / 64 < slimbs) ? (e[i / 64] >> (i % 64)) & 1 : 0;
+            cswap_limbs(r0, r1, stride, bit);
+            ws_add_one(c, r0, r1, r1);
+            ws_add_one(c, r0, r0, r0);
+            cswap_limbs(r0, r1, stride, bit);
+        }
+        std::memcpy(out + k * stride, r0, sizeof(uint64_t) * stride);
     }
 }
 

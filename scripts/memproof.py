@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Memory proof for the never-replicate mesh layout.
+
+The scale claim (parallel/mesh.py): at BASELINE config 5 — BLS12-381 G1,
+n=16384, t=5461 — the replicated E tensor alone (~26 GB) exceeds a v5e
+chip's HBM, so the layout must never materialise an O(n*t) replicated
+tensor.  Runtime measurement at that shape is impossible on this box, so
+this script proves the claim STATICALLY, the way XLA itself sizes
+buffers: lower + compile the actual sharded pipeline (deal, then
+verify+finalise) over an 8-device mesh with abstract inputs, then
+
+1. read the compiled executable's per-device memory analysis (argument /
+   output / temp / peak bytes) and check peak fits the HBM budget;
+2. scan the optimised HLO for collective ops (all-gather / all-to-all /
+   collective-permute) and check no collective RESULT is as large as the
+   full commitment tensor E — the signature of an accidental
+   replication (the designed collectives are O(ndev*t) partial-RLC
+   gathers and the O(n*n/ndev) share all_to_all).
+
+Writes one JSON artifact (default MEMPROOF.json at the repo root) and
+prints it.  The fast regression twin of this check lives in
+tests/test_memproof.py.
+
+Reference workload being sized: the round-1/2 broadcast + verify of
+committee.rs:151-186, :292-296 at SURVEY §6 scale.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import re
+import sys
+
+if __name__ == "__main__":  # virtual mesh before jax init
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dkg_tpu.dkg import ceremony as ce
+from dkg_tpu.groups import device as gd
+from dkg_tpu.parallel import mesh as pmesh
+
+# HLO ops that move data between shards.
+_COLLECTIVE_OP_RE = re.compile(
+    r"\b(all-gather|all-to-all|all-reduce|collective-permute)(?:-start)?\("
+)
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+_DTYPE_BYTES = {
+    "u8": 1, "s8": 1, "pred": 1, "u16": 2, "s16": 2, "bf16": 2, "f16": 2,
+    "u32": 4, "s32": 4, "f32": 4, "u64": 8, "s64": 8, "f64": 8,
+}
+
+
+def _shape_bytes(shape_str: str) -> int:
+    """Total bytes of an HLO result type string (tuples summed)."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape_str):
+        if dtype not in _DTYPE_BYTES:
+            continue
+        count = 1
+        for d in dims.split(","):
+            if d:
+                count *= int(d)
+        total += count * _DTYPE_BYTES[dtype]
+    return total
+
+
+def collective_results(hlo_text: str) -> list[dict]:
+    """Every collective in the optimised HLO with its RESULT size.
+
+    Line-based: an HLO instruction line is ``%name = <type> op(...)``;
+    the result type (possibly a tuple) is everything left of the op
+    token, so summing that side's ``dtype[dims]`` shapes sizes the
+    buffer the collective materialises on each device.
+    """
+    out = []
+    for line in hlo_text.splitlines():
+        m = _COLLECTIVE_OP_RE.search(line)
+        if m is None or "=" not in line[: m.start()]:
+            continue
+        result_type = line[line.index("=") + 1 : m.start()].strip()
+        out.append(
+            {
+                "op": m.group(1),
+                "result": result_type[:120],
+                "bytes": _shape_bytes(result_type),
+            }
+        )
+    return out
+
+
+def analyse(cfg: ce.CeremonyConfig, mesh, window: int, rho_bits: int) -> dict:
+    cs = cfg.cs
+    fs, bf = cs.scalar, cs.field
+    n, t = cfg.n, cfg.t
+    nw = fs.limbs * (16 // window)
+    u32 = jnp.uint32
+
+    def sds(shape, spec):
+        return jax.ShapeDtypeStruct(
+            shape, u32, sharding=NamedSharding(mesh, spec)
+        )
+
+    shard = P(pmesh.PARTY_AXIS)
+    repl = P()
+    args_deal = (
+        sds((n, t + 1, fs.limbs), shard),  # coeffs_a
+        sds((n, t + 1, fs.limbs), shard),  # coeffs_b
+        sds((nw, 1 << window, cs.ncoords, bf.limbs), repl),  # g_table
+        sds((nw, 1 << window, cs.ncoords, bf.limbs), repl),  # h_table
+    )
+
+    deal_fn = jax.jit(
+        lambda ca, cb, gt, ht: pmesh.sharded_deal(cfg, mesh, ca, cb, gt, ht)
+    )
+    deal_exec = deal_fn.lower(*args_deal).compile()
+
+    pt = (n, t + 1, cs.ncoords, bf.limbs)
+    args_verify = (
+        sds(pt, shard),  # a
+        sds(pt, shard),  # e
+        sds((n, n, fs.limbs), shard),  # s
+        sds((n, n, fs.limbs), shard),  # r
+        args_deal[2],
+        args_deal[3],
+        sds((n, fs.limbs), repl),  # rho
+    )
+    verify_fn = jax.jit(
+        lambda a, e, s, r, gt, ht, rho: pmesh.sharded_verify_finalise(
+            cfg, mesh, a, e, s, r, gt, ht, rho, rho_bits
+        )
+    )
+    verify_exec = verify_fn.lower(*args_verify).compile()
+
+    full_e_bytes = n * (t + 1) * cs.ncoords * bf.limbs * 4
+
+    def phase_report(executable) -> dict:
+        ma = executable.memory_analysis()
+        colls = collective_results(executable.as_text())
+        return {
+            # per-device bytes (XLA sizes buffers per participating device)
+            "argument_bytes": int(ma.argument_size_in_bytes),
+            "output_bytes": int(ma.output_size_in_bytes),
+            "temp_bytes": int(ma.temp_size_in_bytes),
+            "peak_bytes": int(
+                ma.argument_size_in_bytes
+                + ma.output_size_in_bytes
+                + ma.temp_size_in_bytes
+            ),
+            "collectives": sorted(
+                colls, key=lambda c: -c["bytes"]
+            )[:8],
+            "max_collective_bytes": max((c["bytes"] for c in colls), default=0),
+        }
+
+    report = {
+        "config": {
+            "curve": cfg.curve,
+            "n": n,
+            "t": t,
+            "n_devices": int(mesh.devices.size),
+            "fb_window": window,
+            "rho_bits": rho_bits,
+        },
+        "full_e_tensor_bytes": full_e_bytes,
+        "deal": phase_report(deal_exec),
+        "verify_finalise": phase_report(verify_exec),
+    }
+    worst = max(
+        report["deal"]["max_collective_bytes"],
+        report["verify_finalise"]["max_collective_bytes"],
+    )
+    report["never_replicates_e"] = worst < full_e_bytes
+    report["hbm_headroom_v5e"] = {
+        "budget_bytes": 16 << 30,
+        "peak_bytes": max(
+            report["deal"]["peak_bytes"], report["verify_finalise"]["peak_bytes"]
+        ),
+        "fits": max(
+            report["deal"]["peak_bytes"], report["verify_finalise"]["peak_bytes"]
+        )
+        < (16 << 30),
+    }
+    return report
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--curve", default="bls12_381_g1")
+    ap.add_argument("--n", type=int, default=16384)
+    ap.add_argument("--t", type=int, default=5461)
+    ap.add_argument("--ndev", type=int, default=8)
+    ap.add_argument("--window", type=int, default=8)
+    ap.add_argument("--rho-bits", type=int, default=128)
+    ap.add_argument("--out", default=str(pathlib.Path(__file__).parent.parent / "MEMPROOF.json"))
+    args = ap.parse_args()
+
+    mesh = pmesh.make_mesh(args.ndev)
+    cfg = ce.CeremonyConfig(args.curve, args.n, args.t)
+    report = analyse(cfg, mesh, args.window, args.rho_bits)
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report))
+    if not report["never_replicates_e"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

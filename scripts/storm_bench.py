@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Adversarial complaint-storm adjudication benchmark.
+
+Worst case the threshold bound admits (reference committee.rs:369-398):
+~t complaints arrive in round 3 and EVERY one must be re-verified — two
+DLEQ proofs plus a Pedersen/MSM share re-check per complaint.  The
+reference does this serially per complaint (broadcast.rs:50-98); here
+the whole storm is adjudicated by complaints_batch.adjudicate_round1_batch
+(one batched device DLEQ verify + one batched commitment re-check).
+
+Storm construction: one bad dealer wire-deals to n recipients
+(device-batched KEM/DEM), its payloads to the first k recipients are
+corrupted, and each of those k accusers generates a genuine
+ProofOfMisbehaviour; one additional FALSE accusation checks the court
+still rejects under load.  The reported rate is upheld-verified
+complaints per second through the batch court.
+
+Writes STORM.json at the repo root:  {n, t, k, platform,
+complaint_gen_s, adjudicate_s, complaints_per_sec, verdicts_ok}.
+
+Usage: python scripts/storm_bench.py [--n 1024] [--t 341] [--curve ristretto255]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import random
+import sys
+import time
+from dataclasses import replace
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1024)
+    ap.add_argument("--t", type=int, default=341)
+    ap.add_argument("--curve", default="ristretto255")
+    ap.add_argument("--out", default=str(pathlib.Path(__file__).parent.parent / "STORM.json"))
+    args = ap.parse_args()
+
+    import jax
+
+    from dkg_tpu.dkg import complaints_batch as cb
+    from dkg_tpu.dkg.broadcast import EncryptedShares, MisbehavingPartiesRound1, ProofOfMisbehaviour
+    from dkg_tpu.dkg.committee import Environment
+    from dkg_tpu.dkg.committee_batch import batched_dealing
+    from dkg_tpu.dkg.errors import DkgErrorKind
+    from dkg_tpu.dkg.procedure_keys import MemberCommunicationKey, sort_committee
+    from dkg_tpu.groups import device as gd
+    from dkg_tpu.groups import host as gh
+
+    rng = random.Random(0x5702)
+    n, t, k = args.n, args.t, args.t
+    group = gh.ALL_GROUPS[args.curve]
+    cs = gd.ALL_CURVES[args.curve]
+    env = Environment.init(group, t, n, b"storm-bench")
+    keys = [MemberCommunicationKey.generate(group, rng) for _ in range(n)]
+    pks = sort_committee(group, [key.public() for key in keys])
+    by_enc = {group.encode(key.public().point): key for key in keys}
+    sorted_keys = [by_enc[group.encode(p.point)] for p in pks]
+
+    # the bad dealer (party 1) wire-deals to everyone, device-batched
+    t0 = time.perf_counter()
+    ((_, broadcast),) = batched_dealing(env, rng, keys, members=[1])
+    deal_s = time.perf_counter() - t0
+
+    # corrupt the payloads delivered to accusers 2..k+1
+    es = list(broadcast.encrypted_shares)
+    accusers = list(range(2, k + 2))
+    for a in accusers:
+        old = es[a - 1]
+        bad_ct = replace(
+            old.share_ct,
+            ciphertext=bytes([old.share_ct.ciphertext[0] ^ 1])
+            + old.share_ct.ciphertext[1:],
+        )
+        es[a - 1] = EncryptedShares(old.recipient_index, bad_ct, old.randomness_ct)
+    tampered = replace(broadcast, encrypted_shares=tuple(es))
+
+    # each accuser generates evidence (2 correct-decryption-key ZKPs)
+    t0 = time.perf_counter()
+    triples = []
+    for a in accusers:
+        mine = tampered.shares_for(a)
+        proof = ProofOfMisbehaviour.generate(group, mine, sorted_keys[a - 1], rng)
+        triples.append(
+            (a, pks[a - 1], MisbehavingPartiesRound1(1, DkgErrorKind.SHARE_VALIDITY_FAILED, proof))
+        )
+    # one false accusation: honest payload, accuser k+2
+    fa = k + 2
+    false_proof = ProofOfMisbehaviour.generate(
+        group, tampered.shares_for(fa), sorted_keys[fa - 1], rng
+    )
+    triples.append(
+        (fa, pks[fa - 1], MisbehavingPartiesRound1(1, DkgErrorKind.SHARE_VALIDITY_FAILED, false_proof))
+    )
+    gen_s = time.perf_counter() - t0
+
+    by_sender = {1: tampered}
+    # warm the device kernels (jit compile) before timing
+    cb.adjudicate_round1_batch(group, cs, env.commitment_key, triples[:2], by_sender)
+    t0 = time.perf_counter()
+    verdicts = cb.adjudicate_round1_batch(group, cs, env.commitment_key, triples, by_sender)
+    adj_s = time.perf_counter() - t0
+
+    ok = all(verdicts[:-1]) and not verdicts[-1]
+    report = {
+        "n": n,
+        "t": t,
+        "complaints": len(triples),
+        "curve": args.curve,
+        "platform": jax.devices()[0].platform,
+        "deal_s": round(deal_s, 3),
+        "complaint_gen_s": round(gen_s, 3),
+        "adjudicate_s": round(adj_s, 3),
+        "complaints_per_sec": round(len(triples) / adj_s, 1),
+        "verdicts_ok": ok,
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report))
+    if not ok:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""Complaint-storm adjudication at scale (slow tier).
+
+The adversarial worst case the threshold bound admits: ~t complaints in
+one round, every one re-verified (reference committee.rs:369-398 ->
+broadcast.rs:50-98).  Drives a genuine storm — one bad dealer, t
+corrupted payloads, t independent accusers with real evidence plus one
+false accusation — through the batched court and checks every verdict
+against the serial oracle.  The full-scale timed artifact twin is
+scripts/storm_bench.py (STORM.json).
+"""
+
+import random
+from dataclasses import replace
+
+import pytest
+
+from dkg_tpu.dkg import complaints_batch as cb
+from dkg_tpu.dkg.broadcast import (
+    EncryptedShares,
+    MisbehavingPartiesRound1,
+    ProofOfMisbehaviour,
+)
+from dkg_tpu.dkg.committee import Environment
+from dkg_tpu.dkg.committee_batch import batched_dealing
+from dkg_tpu.dkg.errors import DkgErrorKind
+from dkg_tpu.dkg.procedure_keys import MemberCommunicationKey, sort_committee
+from dkg_tpu.groups import device as gd
+from dkg_tpu.groups import host as gh
+
+RNG = random.Random(0x5703)
+
+
+@pytest.mark.slow
+def test_storm_of_t_complaints_matches_serial():
+    n, t = 64, 21
+    group, cs = gh.RISTRETTO255, gd.RISTRETTO255
+    env = Environment.init(group, t, n, b"storm-test")
+    keys = [MemberCommunicationKey.generate(group, RNG) for _ in range(n)]
+    pks = sort_committee(group, [k.public() for k in keys])
+    by_enc = {group.encode(k.public().point): k for k in keys}
+    sorted_keys = [by_enc[group.encode(p.point)] for p in pks]
+
+    ((_, broadcast),) = batched_dealing(env, RNG, keys, members=[1])
+
+    es = list(broadcast.encrypted_shares)
+    accusers = list(range(2, t + 2))
+    for a in accusers:
+        old = es[a - 1]
+        bad_ct = replace(
+            old.share_ct,
+            ciphertext=bytes([old.share_ct.ciphertext[0] ^ 1])
+            + old.share_ct.ciphertext[1:],
+        )
+        es[a - 1] = EncryptedShares(old.recipient_index, bad_ct, old.randomness_ct)
+    tampered = replace(broadcast, encrypted_shares=tuple(es))
+
+    triples = []
+    for a in accusers:
+        proof = ProofOfMisbehaviour.generate(
+            group, tampered.shares_for(a), sorted_keys[a - 1], RNG
+        )
+        triples.append(
+            (a, pks[a - 1], MisbehavingPartiesRound1(1, DkgErrorKind.SHARE_VALIDITY_FAILED, proof))
+        )
+    # false accusation with an honest payload
+    fa = t + 2
+    false_proof = ProofOfMisbehaviour.generate(
+        group, tampered.shares_for(fa), sorted_keys[fa - 1], RNG
+    )
+    triples.append(
+        (fa, pks[fa - 1], MisbehavingPartiesRound1(1, DkgErrorKind.SHARE_VALIDITY_FAILED, false_proof))
+    )
+
+    by_sender = {1: tampered}
+    batch = cb.adjudicate_round1_batch(group, cs, env.commitment_key, triples, by_sender)
+    serial = [
+        m.verify(group, env.commitment_key, a_i, a_pk, tampered)
+        for a_i, a_pk, m in triples
+    ]
+    assert batch == serial
+    assert batch == [True] * t + [False]

@@ -70,6 +70,39 @@ def test_native_curve_parity(g, kind, const):
         assert g.eq(c, g.scalar_mul(k, g.generator()))
 
 
+GROUPS = [gh.RISTRETTO255, gh.SECP256K1, gh.BLS12_381_G1]
+
+
+@pytest.mark.parametrize("g", GROUPS, ids=[g.name for g in GROUPS])
+def test_native_ct_ladder_limb_exact(g):
+    """The C++ constant-structure ladder (the wire-path secret-scalar
+    route, HostGroup.scalar_mul) is LIMB-EXACT vs the Python Montgomery
+    ladder — same op sequence over the same complete formulas, so even
+    the non-unique projective coordinates must agree."""
+    nc = gh._native_curve(g)
+    assert nc is not None, "native curve context should build here"
+    order = g.scalar_field.modulus
+    ks = [RNG.randrange(order) for _ in range(4)] + [0, 1, 2, order - 1]
+    base_pts = [g.generator()] * len(ks)
+    out = nc.decode_points(
+        nc.scalar_mul_ct(ks, nc.encode_points(base_pts), order)
+    )
+    for k, got in zip(ks, out):
+        want = g._scalar_mul_ladder(k, g.generator())
+        assert tuple(got) == tuple(int(c) for c in want)
+        # and projectively correct vs the independent vartime path
+        assert g.eq(got, g.scalar_mul_vartime(k, g.generator()))
+
+
+@pytest.mark.parametrize("g", GROUPS, ids=[g.name for g in GROUPS])
+def test_scalar_mul_routes_native(g):
+    """HostGroup.scalar_mul output is unchanged by the native routing
+    (covers the KEM/dealing wire path end to end)."""
+    k = RNG.randrange(g.scalar_field.modulus)
+    p = g.scalar_mul_vartime(RNG.randrange(g.scalar_field.modulus), g.generator())
+    assert tuple(g.scalar_mul(k, p)) == tuple(g._scalar_mul_ladder(k, p))
+
+
 def test_native_chacha_matches_python():
     from dkg_tpu.crypto.chacha import chacha20_xor as py_chacha
 
