@@ -21,10 +21,37 @@ import random
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
+# jax is imported LAZILY (_import_jax): with the ambient env pinning
+# JAX_PLATFORMS to the TPU plugin and the tunnel in its worst failure
+# mode, plugin registration during `import jax` itself hangs in a retry
+# sleep (observed live) — so the import must happen only after the
+# subprocess probe has decided the backend is usable (or downgraded the
+# env to CPU, which skips the plugin entirely).
+jax = None
+jnp = None
 
 NORTH_STAR_RATE_PER_CHIP = 4096 * 4096 / 10.0 / 8.0
+
+
+def _import_jax():
+    global jax, jnp
+    if jax is None:
+        import jax as _jax
+        import jax.numpy as _jnp
+
+        jax = _jax
+        jnp = _jnp
+    return jax
+
+
+def _configure_cache() -> None:
+    """One persistent compile cache shared by the parent and every
+    child stage — the property that makes the child-per-stage design
+    cheap (a re-spawned stage reloads its executables instead of
+    recompiling)."""
+    _import_jax()
+    jax.config.update("jax_compilation_cache_dir", "/tmp/dkg_tpu_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
 def _pallas_active() -> bool:
@@ -115,8 +142,7 @@ def _north_star_child(n_ns: int, t_ns: int) -> None:
 
     from dkg_tpu.dkg import ceremony as ce
 
-    jax.config.update("jax_compilation_cache_dir", "/tmp/dkg_tpu_jax_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    _configure_cache()
     rng = random.Random(0x4096)
     c = ce.BatchedCeremony("secp256k1", n_ns, t_ns, b"north-star", rng)
     t0 = _time.perf_counter()
@@ -157,32 +183,74 @@ def north_star_rung():
     n=4096 extrapolation is reported explicitly.  Returns a dict for
     the JSON line's ``north_star`` slot.
     """
-    import subprocess
-
     t_ns = 1365
     for n_ns, timeout_s in ((4096, 540.0), (2048, 360.0), (1024, 300.0)):
-        try:
-            r = subprocess.run(
-                [
-                    sys.executable,
-                    "-c",
-                    "import bench; bench._north_star_child(%d, %d)" % (n_ns, t_ns),
-                ],
-                timeout=timeout_s,
-                capture_output=True,
-                text=True,
-                cwd=str(__import__("pathlib").Path(__file__).parent),
-            )
-            if r.returncode == 0 and r.stdout.strip():
-                return json.loads(r.stdout.strip().splitlines()[-1])
-            print(
-                f"north-star rung n={n_ns} rc={r.returncode}: "
-                + r.stderr.strip()[-200:],
-                file=sys.stderr,
-            )
-        except Exception as exc:  # noqa: BLE001 — timeout: shrink and retry
-            print(f"north-star rung n={n_ns}: {exc}", file=sys.stderr)
+        res = _child(
+            "import bench; bench._north_star_child(%d, %d)" % (n_ns, t_ns),
+            timeout_s,
+        )
+        if res is not None:
+            return res
+        print(f"north-star rung n={n_ns} failed", file=sys.stderr)
     return {"error": "all north-star rungs failed"}
+
+
+def _child(code: str, timeout_s: float) -> dict | None:
+    """Run a bench stage in a killable child; parse its last stdout line.
+
+    EVERY measuring stage runs this way: a wedged tunnel or stalled
+    remote compile costs that stage its timeout, never the artifact
+    (the round-2 lesson, generalised after watching a live wedge stall
+    an in-process rung indefinitely this round).  The persistent compile
+    cache makes the lost warm state cheap to rebuild.
+    """
+    import pathlib
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+            cwd=str(pathlib.Path(__file__).parent),
+        )
+    except Exception as exc:  # noqa: BLE001 — timeout/spawn failure
+        print(f"bench child timed out/failed: {exc}", file=sys.stderr)
+        return None
+    if r.returncode != 0 or not r.stdout.strip():
+        print(
+            f"bench child rc={r.returncode}: {r.stderr.strip()[-300:]}",
+            file=sys.stderr,
+        )
+        return None
+    try:
+        return json.loads(r.stdout.strip().splitlines()[-1])
+    except ValueError:
+        print(f"bench child bad output: {r.stdout[-200:]}", file=sys.stderr)
+        return None
+
+
+def _rung_child(curve: str, n: int, t: int) -> None:
+    """One ladder rung, measured in a child process (flags arrive via
+    the environment, set by the parent before spawning)."""
+    _configure_cache()
+    t_deal, t_verify, t_rho = run(curve, n, t)
+    print(
+        json.dumps(
+            {
+                "deal_s": round(t_deal, 3),
+                "verify_s": round(t_verify, 3),
+                "fiat_shamir_s": round(t_rho, 3),
+                "pallas": _pallas_active(),
+            }
+        )
+    )
+
+
+def _parity_child() -> None:
+    _configure_cache()
+    print(json.dumps({"parity": parity_check()}))
 
 
 def run(curve: str, n: int, t: int, rho_bits: int = 128):
@@ -244,20 +312,35 @@ def _init_platform() -> str | None:
     """
     import os
 
-    # parity_check needs a CPU backend next to the TPU one; the ambient
-    # env pins JAX_PLATFORMS to the tpu plugin only, so widen it BEFORE
-    # the first jax touch (a platform list initialises all named backends).
+    # PROBE FIRST, IMPORT SECOND: jax must not be imported until the
+    # probe has decided the accelerator is usable or downgraded the env
+    # to CPU (see the lazy-import note at the top of this file).
     plat_env = os.environ.get("JAX_PLATFORMS")
     accel_named = plat_env and any(p != "cpu" for p in plat_env.split(","))
     if accel_named and not _accelerator_usable():
         print(
             f"accelerator backend ({plat_env}) unusable (dead/wedged tunnel); "
-            "falling back to CPU",
+            "falling back to CPU via re-exec",
             file=sys.stderr,
         )
+        # Re-exec, not just setenv: the accelerator site hook's
+        # backend-init monkeypatch initialises the plugin client on ANY
+        # backend request — even jax_platforms=cpu — and hangs there on
+        # a dead tunnel (captured stack: _axon_get_backend_uncached ->
+        # make_pjrt_c_api_client).  Setting PYTHONPATH at interpreter
+        # startup is what actually disables the plugin's discovery
+        # (.claude/skills/verify/SKILL.md), so both vars go into a fresh
+        # interpreter's env.
+        import pathlib
+
         os.environ["JAX_PLATFORMS"] = "cpu"
-        jax.config.update("jax_platforms", "cpu")
-        plat_env = "cpu"
+        os.environ["PYTHONPATH"] = str(pathlib.Path(__file__).parent)
+        os.execv(sys.executable, [sys.executable, __file__])
+    _import_jax()
+    # parity_check needs a CPU backend next to the TPU one; the ambient
+    # env pins JAX_PLATFORMS to the tpu plugin only, so widen it BEFORE
+    # the first backend touch (a platform list initialises all named
+    # backends).
     if plat_env and "cpu" not in plat_env.split(","):
         jax.config.update("jax_platforms", plat_env + ",cpu")
     try:
@@ -282,9 +365,9 @@ def _init_platform() -> str | None:
 def main():
     import os
 
-    jax.config.update("jax_compilation_cache_dir", "/tmp/dkg_tpu_jax_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    platform = _init_platform()
+    platform = _init_platform()  # imports jax once the env is safe
+    if platform is not None:
+        _configure_cache()
     if platform is None:
         print(
             json.dumps(
@@ -298,76 +381,93 @@ def main():
             )
         )
         return
-    # (curve, n, t, extra-env): north-star curve; size per platform so
-    # the bench finishes promptly (BASELINE.json config #3 shape on
-    # TPU).  The second TPU rung retries the SAME size with the new
-    # fast-path features disabled (MXU int8 matmul, 16-bit-window
-    # device tables) — insurance so a lowering failure in a new default
-    # degrades the measured rate instead of zeroing the bench.
-    conservative = {"DKG_TPU_MXU": "0", "DKG_TPU_FB_WINDOW": "8"}
+    # (curve, n, t, extra-env, timeout): north-star curve; size per
+    # platform so the bench finishes promptly (BASELINE.json config #3
+    # shape on TPU).  The second TPU rung retries the SAME size with the
+    # new fast-path features disabled (MXU int8 matmul, 16-bit-window
+    # device tables) — insurance so a lowering failure OR a pathological
+    # slowdown in a new default degrades the measured rate instead of
+    # zeroing (or stalling) the bench.  Every rung runs in a killable
+    # child under a hard timeout (_child).
+    # conservative == the EXACT round-1 measured configuration: pure-XLA
+    # point path (no fused Pallas kernels), no MXU matmul, 8-bit
+    # host-built tables — every round-2+ fast-path default off, so a
+    # regression in ANY of them still yields a measured rate.
+    conservative = {
+        "DKG_TPU_MXU": "0",
+        "DKG_TPU_FB_WINDOW": "8",
+        "DKG_TPU_PALLAS": "0",
+    }
     if platform == "tpu":
         ladder = [
-            ("secp256k1", 1024, 341, {}),
-            ("secp256k1", 1024, 341, conservative),
-            ("secp256k1", 256, 85, conservative),
+            ("secp256k1", 1024, 341, {}, 1500.0),
+            ("secp256k1", 1024, 341, conservative, 900.0),
+            ("secp256k1", 256, 85, conservative, 600.0),
         ]
     else:
-        ladder = [("secp256k1", 64, 21, {})]
+        ladder = [("secp256k1", 64, 21, {}, 1800.0)]
 
-    for curve, n, t, extra_env in ladder:
+    for curve, n, t, extra_env, timeout_s in ladder:
+        saved = {k: os.environ.get(k) for k in extra_env}
+        os.environ.update(extra_env)  # children inherit the rung flags
         try:
-            os.environ.update(extra_env)
-            if extra_env:
-                # free the default rung's residue before a conservative
-                # retry: the ~200MB-per-base window-16 device tables are
-                # pinned by their cache and would defeat an OOM fallback
-                from dkg_tpu.groups import device as gd
-
-                gd._fixed_table_dev_cached.cache_clear()
-            t_deal, t_verify, t_rho = run(curve, n, t)
-            pairs = n * (n - 1)
-            rate = pairs / t_verify
-            try:
-                # On TPU this is the real cross-device bit-exactness bit;
-                # on CPU it still cross-checks the fused-kernel path
-                # against the independent pure-XLA formulation.
-                parity = parity_check()
-            except Exception as exc:  # noqa: BLE001 — parity is reported, not fatal
-                print(f"parity check failed to run: {exc}", file=sys.stderr)
-                parity = False
-            north_star = None
-            if platform == "tpu" and os.environ.get("DKG_TPU_BENCH_NS") != "0":
-                try:
-                    north_star = north_star_rung()
-                except Exception as exc:  # noqa: BLE001 — reported, not fatal
-                    print(f"north-star rung crashed: {exc}", file=sys.stderr)
-                    north_star = {"error": str(exc)[:200]}
-            print(
-                json.dumps(
-                    {
-                        "metric": "share_verify_pairs_per_sec_per_chip",
-                        "value": round(rate, 1),
-                        "unit": "pair-verifications/s",
-                        "vs_baseline": round(rate / NORTH_STAR_RATE_PER_CHIP, 4),
-                        "config": {
-                            "curve": curve,
-                            "n": n,
-                            "t": t,
-                            "platform": platform,
-                            "deal_s": round(t_deal, 3),
-                            "verify_s": round(t_verify, 3),
-                            "fiat_shamir_s": round(t_rho, 3),
-                            "pallas": _pallas_active(),
-                            "flags": extra_env,  # {} == defaults
-                            "tpu_cpu_bit_exact": parity,
-                            "north_star": north_star,
-                        },
-                    }
-                )
+            res = _child(
+                "import bench; bench._rung_child(%r, %d, %d)" % (curve, n, t),
+                timeout_s,
             )
-            return
-        except Exception as exc:  # noqa: BLE001 — fall to smaller config
-            print(f"bench config {curve} n={n} failed: {exc}", file=sys.stderr)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        if res is None:
+            print(f"bench config {curve} n={n} failed", file=sys.stderr)
+            continue
+        pairs = n * (n - 1)
+        rate = pairs / res["verify_s"]
+        # On TPU this is the real cross-device bit-exactness bit; on CPU
+        # it still cross-checks the fused-kernel path against the
+        # independent pure-XLA formulation.  Runs under the winning
+        # rung's flags so it validates the configuration actually
+        # measured.
+        os.environ.update(extra_env)
+        try:
+            parity_res = _child("import bench; bench._parity_child()", 900.0)
+        finally:
+            for k in extra_env:
+                if saved.get(k) is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = saved[k]
+        parity = bool(parity_res["parity"]) if parity_res else False
+        north_star = None
+        if platform == "tpu" and os.environ.get("DKG_TPU_BENCH_NS") != "0":
+            north_star = north_star_rung()
+        print(
+            json.dumps(
+                {
+                    "metric": "share_verify_pairs_per_sec_per_chip",
+                    "value": round(rate, 1),
+                    "unit": "pair-verifications/s",
+                    "vs_baseline": round(rate / NORTH_STAR_RATE_PER_CHIP, 4),
+                    "config": {
+                        "curve": curve,
+                        "n": n,
+                        "t": t,
+                        "platform": platform,
+                        "deal_s": res["deal_s"],
+                        "verify_s": res["verify_s"],
+                        "fiat_shamir_s": res["fiat_shamir_s"],
+                        "pallas": res["pallas"],
+                        "flags": extra_env,  # {} == defaults
+                        "tpu_cpu_bit_exact": parity,
+                        "north_star": north_star,
+                    },
+                }
+            )
+        )
+        return
     print(
         json.dumps(
             {

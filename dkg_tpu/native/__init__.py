@@ -70,14 +70,26 @@ def _load() -> Optional[ctypes.CDLL]:
             return None
     try:
         return _bind(ctypes.CDLL(str(_LIB)))
-    except OSError:
-        # builds-but-won't-load (e.g. a MinGW DLL whose runtime deps are
-        # not on the DLL search path) or a stale lib missing newly
-        # required symbols: cache the failure so available() gates every
-        # use, as promised — never raise out of the optional runtime.
+    except AttributeError:
+        # stale library missing newly required symbols despite a fresh
+        # mtime (same-second checkouts, archive extraction): rebuild
+        # once from source before giving up.
+        try:
+            _LIB.unlink()
+        except OSError:
+            pass
+        if _build():
+            try:
+                return _bind(ctypes.CDLL(str(_LIB)))
+            except (OSError, AttributeError):
+                pass
         _build_failed = True
         return None
-    except AttributeError:
+    except OSError:
+        # builds-but-won't-load (e.g. a MinGW DLL whose runtime deps are
+        # not on the DLL search path): cache the failure so available()
+        # gates every use, as promised — never raise out of the optional
+        # runtime.
         _build_failed = True
         return None
 

@@ -34,6 +34,61 @@ from dataclasses import replace
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 
+def build_storm(group, env, keys, pks, sorted_keys, rng, k):
+    """Construct the canonical storm: the bad dealer (party 1) wire-deals
+    to everyone (device-batched), its payloads to accusers 2..k+1 are
+    corrupted, each accuser generates genuine evidence, and one FALSE
+    accusation (honest payload, accuser k+2) rides along.
+
+    Returns (tampered_broadcast, triples, deal_seconds).  THE single
+    definition of the adversarial shape — tests/test_complaint_storm.py
+    validates exactly this construction at small n and STORM.json
+    benchmarks it at scale.
+    """
+    from dkg_tpu.dkg.broadcast import (
+        EncryptedShares,
+        MisbehavingPartiesRound1,
+        ProofOfMisbehaviour,
+    )
+    from dkg_tpu.dkg.committee_batch import batched_dealing
+    from dkg_tpu.dkg.errors import DkgErrorKind
+
+    t0 = time.perf_counter()
+    ((_, broadcast),) = batched_dealing(env, rng, keys, members=[1])
+    deal_s = time.perf_counter() - t0
+
+    es = list(broadcast.encrypted_shares)
+    accusers = list(range(2, k + 2))
+    for a in accusers:
+        old = es[a - 1]
+        bad_ct = replace(
+            old.share_ct,
+            ciphertext=bytes([old.share_ct.ciphertext[0] ^ 1])
+            + old.share_ct.ciphertext[1:],
+        )
+        es[a - 1] = EncryptedShares(old.recipient_index, bad_ct, old.randomness_ct)
+    tampered = replace(broadcast, encrypted_shares=tuple(es))
+
+    triples = []
+    for a in accusers:
+        proof = ProofOfMisbehaviour.generate(
+            group, tampered.shares_for(a), sorted_keys[a - 1], rng
+        )
+        triples.append(
+            (a, pks[a - 1],
+             MisbehavingPartiesRound1(1, DkgErrorKind.SHARE_VALIDITY_FAILED, proof))
+        )
+    fa = k + 2
+    false_proof = ProofOfMisbehaviour.generate(
+        group, tampered.shares_for(fa), sorted_keys[fa - 1], rng
+    )
+    triples.append(
+        (fa, pks[fa - 1],
+         MisbehavingPartiesRound1(1, DkgErrorKind.SHARE_VALIDITY_FAILED, false_proof))
+    )
+    return tampered, triples, deal_s
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=1024)
@@ -45,10 +100,7 @@ def main() -> None:
     import jax
 
     from dkg_tpu.dkg import complaints_batch as cb
-    from dkg_tpu.dkg.broadcast import EncryptedShares, MisbehavingPartiesRound1, ProofOfMisbehaviour
     from dkg_tpu.dkg.committee import Environment
-    from dkg_tpu.dkg.committee_batch import batched_dealing
-    from dkg_tpu.dkg.errors import DkgErrorKind
     from dkg_tpu.dkg.procedure_keys import MemberCommunicationKey, sort_committee
     from dkg_tpu.groups import device as gd
     from dkg_tpu.groups import host as gh
@@ -63,42 +115,9 @@ def main() -> None:
     by_enc = {group.encode(key.public().point): key for key in keys}
     sorted_keys = [by_enc[group.encode(p.point)] for p in pks]
 
-    # the bad dealer (party 1) wire-deals to everyone, device-batched
     t0 = time.perf_counter()
-    ((_, broadcast),) = batched_dealing(env, rng, keys, members=[1])
-    deal_s = time.perf_counter() - t0
-
-    # corrupt the payloads delivered to accusers 2..k+1
-    es = list(broadcast.encrypted_shares)
-    accusers = list(range(2, k + 2))
-    for a in accusers:
-        old = es[a - 1]
-        bad_ct = replace(
-            old.share_ct,
-            ciphertext=bytes([old.share_ct.ciphertext[0] ^ 1])
-            + old.share_ct.ciphertext[1:],
-        )
-        es[a - 1] = EncryptedShares(old.recipient_index, bad_ct, old.randomness_ct)
-    tampered = replace(broadcast, encrypted_shares=tuple(es))
-
-    # each accuser generates evidence (2 correct-decryption-key ZKPs)
-    t0 = time.perf_counter()
-    triples = []
-    for a in accusers:
-        mine = tampered.shares_for(a)
-        proof = ProofOfMisbehaviour.generate(group, mine, sorted_keys[a - 1], rng)
-        triples.append(
-            (a, pks[a - 1], MisbehavingPartiesRound1(1, DkgErrorKind.SHARE_VALIDITY_FAILED, proof))
-        )
-    # one false accusation: honest payload, accuser k+2
-    fa = k + 2
-    false_proof = ProofOfMisbehaviour.generate(
-        group, tampered.shares_for(fa), sorted_keys[fa - 1], rng
-    )
-    triples.append(
-        (fa, pks[fa - 1], MisbehavingPartiesRound1(1, DkgErrorKind.SHARE_VALIDITY_FAILED, false_proof))
-    )
-    gen_s = time.perf_counter() - t0
+    tampered, triples, deal_s = build_storm(group, env, keys, pks, sorted_keys, rng, k)
+    gen_s = time.perf_counter() - t0 - deal_s
 
     by_sender = {1: tampered}
     # warm the device kernels (jit compile) before timing

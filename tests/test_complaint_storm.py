@@ -2,30 +2,32 @@
 
 The adversarial worst case the threshold bound admits: ~t complaints in
 one round, every one re-verified (reference committee.rs:369-398 ->
-broadcast.rs:50-98).  Drives a genuine storm — one bad dealer, t
+broadcast.rs:50-98).  Drives the canonical storm — one bad dealer, t
 corrupted payloads, t independent accusers with real evidence plus one
 false accusation — through the batched court and checks every verdict
-against the serial oracle.  The full-scale timed artifact twin is
-scripts/storm_bench.py (STORM.json).
+against the serial oracle.  The storm construction is shared with the
+full-scale timed artifact (scripts/storm_bench.py, STORM.json), so the
+regression test and the benchmark exercise the identical shape.
 """
 
+import importlib.util
+import pathlib
 import random
-from dataclasses import replace
 
 import pytest
 
 from dkg_tpu.dkg import complaints_batch as cb
-from dkg_tpu.dkg.broadcast import (
-    EncryptedShares,
-    MisbehavingPartiesRound1,
-    ProofOfMisbehaviour,
-)
 from dkg_tpu.dkg.committee import Environment
-from dkg_tpu.dkg.committee_batch import batched_dealing
-from dkg_tpu.dkg.errors import DkgErrorKind
 from dkg_tpu.dkg.procedure_keys import MemberCommunicationKey, sort_committee
 from dkg_tpu.groups import device as gd
 from dkg_tpu.groups import host as gh
+
+_SPEC = importlib.util.spec_from_file_location(
+    "storm_bench",
+    pathlib.Path(__file__).resolve().parent.parent / "scripts" / "storm_bench.py",
+)
+storm_bench = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(storm_bench)
 
 RNG = random.Random(0x5703)
 
@@ -40,35 +42,8 @@ def test_storm_of_t_complaints_matches_serial():
     by_enc = {group.encode(k.public().point): k for k in keys}
     sorted_keys = [by_enc[group.encode(p.point)] for p in pks]
 
-    ((_, broadcast),) = batched_dealing(env, RNG, keys, members=[1])
-
-    es = list(broadcast.encrypted_shares)
-    accusers = list(range(2, t + 2))
-    for a in accusers:
-        old = es[a - 1]
-        bad_ct = replace(
-            old.share_ct,
-            ciphertext=bytes([old.share_ct.ciphertext[0] ^ 1])
-            + old.share_ct.ciphertext[1:],
-        )
-        es[a - 1] = EncryptedShares(old.recipient_index, bad_ct, old.randomness_ct)
-    tampered = replace(broadcast, encrypted_shares=tuple(es))
-
-    triples = []
-    for a in accusers:
-        proof = ProofOfMisbehaviour.generate(
-            group, tampered.shares_for(a), sorted_keys[a - 1], RNG
-        )
-        triples.append(
-            (a, pks[a - 1], MisbehavingPartiesRound1(1, DkgErrorKind.SHARE_VALIDITY_FAILED, proof))
-        )
-    # false accusation with an honest payload
-    fa = t + 2
-    false_proof = ProofOfMisbehaviour.generate(
-        group, tampered.shares_for(fa), sorted_keys[fa - 1], RNG
-    )
-    triples.append(
-        (fa, pks[fa - 1], MisbehavingPartiesRound1(1, DkgErrorKind.SHARE_VALIDITY_FAILED, false_proof))
+    tampered, triples, _deal_s = storm_bench.build_storm(
+        group, env, keys, pks, sorted_keys, RNG, t
     )
 
     by_sender = {1: tampered}
