@@ -70,6 +70,7 @@ def sync(tree) -> None:
     """
     import numpy as np
 
+    _import_jax()
     leaves = [x for x in jax.tree_util.tree_leaves(tree) if hasattr(x, "ndim")]
     if leaves:
         leaf = leaves[0]
@@ -249,7 +250,16 @@ def _rung_child(curve: str, n: int, t: int) -> None:
 
 
 def _parity_child() -> None:
+    import os
+
     _configure_cache()
+    # parity_check needs a CPU backend NEXT TO the accelerator one; the
+    # ambient env usually pins JAX_PLATFORMS to the TPU plugin alone, so
+    # widen it before the first backend touch (same as the parent's
+    # _init_platform does for itself).
+    plat_env = os.environ.get("JAX_PLATFORMS")
+    if plat_env and "cpu" not in plat_env.split(","):
+        jax.config.update("jax_platforms", plat_env + ",cpu")
     print(json.dumps({"parity": parity_check()}))
 
 

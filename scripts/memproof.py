@@ -10,7 +10,10 @@ buffers: lower + compile the actual sharded pipeline (deal, then
 verify+finalise) over an 8-device mesh with abstract inputs, then
 
 1. read the compiled executable's per-device memory analysis (argument /
-   output / temp / peak bytes) and check peak fits the HBM budget;
+   output / temp bytes; temp is loose XLA:CPU accounting) and check the
+   RESIDENT footprint — arguments + outputs + largest collective
+   buffer, the tensors that must exist on any backend — fits the HBM
+   budget;
 2. scan the optimised HLO for collective ops (all-gather / all-to-all /
    collective-permute) and check no collective RESULT is as large as the
    full commitment tensor E — the signature of an accidental

@@ -1,7 +1,23 @@
 """Component-level timing of the bench workload (deal + verify_batch)
 at n=1024 t=341 secp256k1 on the real chip.  Coarse (seconds-scale)
 but trustworthy: each stage is synced with a host readback (bench.sync
-— on axon, block_until_ready returns before execution completes)."""
+— on axon, block_until_ready returns before execution completes).
+
+Usage:  python scripts/profile_verify.py [N] (from /root/repo; needs
+the TPU tunnel up).  Feature flags come from the environment exactly as
+in production (DKG_TPU_PALLAS / DKG_TPU_MXU / DKG_TPU_FB_WINDOW), so
+one run per flag set isolates a regression:
+
+    python scripts/profile_verify.py 256                     # defaults
+    DKG_TPU_PALLAS=0 python scripts/profile_verify.py 256    # no fused kernels
+    DKG_TPU_PALLAS=0 DKG_TPU_MXU=0 DKG_TPU_FB_WINDOW=8 \
+        python scripts/profile_verify.py 256                 # round-1 config
+
+Per-stage wall-clocks print AS THEY COMPLETE (flush=True) — if a stage
+stalls, the last printed line names the culprit.  Stage list: table
+build (g and h), each deal component, the Fiat-Shamir digest, each
+verify component.
+"""
 from __future__ import annotations
 
 import os
@@ -24,22 +40,39 @@ from dkg_tpu.groups import device as gd
 N, T = int(sys.argv[1]) if len(sys.argv) > 1 else 1024, None
 T = (N - 1) // 3
 
+from bench import sync as _sync  # the one definition of the readback barrier
+
+print(
+    f"flags: PALLAS={os.environ.get('DKG_TPU_PALLAS', '<default>')} "
+    f"MXU={os.environ.get('DKG_TPU_MXU', '<default>')} "
+    f"FB_WINDOW={os.environ.get('DKG_TPU_FB_WINDOW', '<default>')}",
+    flush=True,
+)
+
+# Table build is a first-class stage: the window-16 device build is a
+# ~1M-lane ladder + Montgomery inversion and has never been timed on
+# chip in isolation.
+_t0 = time.perf_counter()
 c = ce.BatchedCeremony("secp256k1", N, T, b"bench", random.Random(7))
+_sync(c.h_table)
+print(f"{'setup: tables+coeffs':26s} {time.perf_counter()-_t0:8.3f} s", flush=True)
 cfg = c.cfg
 cs = cfg.cs
 fs = cs.scalar
 
 
-from bench import sync as _sync  # the one definition of the readback barrier
-
-
 def timed(name, fn, *args):
+    t0 = time.perf_counter()
     out = fn(*args)
-    _sync(out)
+    _sync(out)  # cold: compile + first run
+    cold = time.perf_counter() - t0
     t0 = time.perf_counter()
     out = fn(*args)
     _sync(out)
-    print(f"{name:26s} {time.perf_counter()-t0:8.3f} s", flush=True)
+    print(
+        f"{name:26s} {time.perf_counter()-t0:8.3f} s   (cold {cold:7.2f} s)",
+        flush=True,
+    )
     return out
 
 
@@ -67,7 +100,9 @@ hidings = timed(
 
 # --- verify components -----------------------------------------------------
 rho_bits = 128
+_t0 = time.perf_counter()
 rho = jnp.asarray(ce.derive_rho(cfg, a_pub, e_comm, shares, hidings, rho_bits))
+print(f"{'fiat-shamir: derive_rho':26s} {time.perf_counter()-_t0:8.3f} s", flush=True)
 
 s_rlc = timed(
     "verify: field_dot s", jax.jit(lambda w, v: ce._field_dot(fs, w, v)), rho, shares
