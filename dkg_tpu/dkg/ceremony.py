@@ -131,17 +131,33 @@ def _point_rlc(cs, weights: jax.Array, points: jax.Array, nbits: int) -> jax.Arr
     weights (m, L) limb arrays with only the low nbits set;
     points (m, ..., C, L) -> (..., C, L).
 
-    Two schedules, same sum: with the fused Pallas kernels active
-    (TPU), windowed Straus (w = 4) — per-point 16-entry tables, then
-    ceil(nbits/4) rounds of (gather + tree-add + ONE fused 4-double
-    window launch), ~2.8x fewer point-adds than bit-at-a-time.  On the
-    XLA fallback, the bit-at-a-time ladder: its scan body is ~2.5x
-    cheaper to COMPILE, which is what the CPU test tier is bound by.
-    """
-    m = points.shape[0]
-    if gd.fused_kernels_active():
-        from ..ops import pallas_point
+    Two schedules, same sum:
 
+    * **Windowed Straus (w = 4)** — per-point 16-entry tables, then
+      ceil(nbits/4) rounds of (gather + tree-add + one 4-double window
+      step), ~2.8x fewer point-adds than bit-at-a-time.  Default on
+      TPU; the window step is the fused Pallas kernel when those are
+      active, a plain XLA 4-double+add otherwise — so the conservative
+      (no-Pallas) TPU configuration still gets the cheaper schedule.
+    * **Bit-at-a-time ladder** — default off-TPU: its scan body is
+      ~2.5x cheaper to COMPILE, which is what the CPU test tier is
+      bound by.
+
+    ``DKG_TPU_RLC=straus|bits`` forces a schedule on any backend (the
+    cross-schedule parity tests use this).  Like every feature flag
+    here, it is read at TRACE time: a jitted caller (verify_batch)
+    caches its executable per static shape, so flipping the env var
+    after a same-shape call reuses the already-traced schedule —
+    set flags before the first call of a process (the bench's
+    child-per-rung design exists exactly for this).
+    """
+    import os
+
+    m = points.shape[0]
+    mode = os.environ.get("DKG_TPU_RLC")
+    fused = gd.fused_kernels_active()
+    use_straus = mode == "straus" or (mode is None and (fused or fd._on_tpu()))
+    if use_straus:
         if points.ndim > 3:
             # Chunk the first trailing batch axis so the per-point
             # Straus tables stay under ~256 MB regardless of (m, t);
@@ -171,7 +187,7 @@ def _point_rlc(cs, weights: jax.Array, points: jax.Array, nbits: int) -> jax.Arr
                 table, jnp.broadcast_to(dig.reshape(shape), points.shape[:-2])
             )  # (m, ..., C, L)
             total = gd._tree_reduce(cs, jnp.moveaxis(contribs, 0, -3), m)
-            return pallas_point.pt_window_step(cs, acc, total, window), None
+            return gd.window_step(cs, acc, total, window, fused), None
 
         init = gd.identity(cs, points.shape[1:-2])
         acc, _ = lax.scan(step, init, digits_rev)

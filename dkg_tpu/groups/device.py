@@ -486,16 +486,10 @@ def _scalar_mul_core(cs: CurveSpec, k: jax.Array, p: jax.Array) -> jax.Array:
     digits = scalar_windows(cs, k)  # (..., NW)
     digits_rev = jnp.moveaxis(digits, -1, 0)[::-1]  # MSB first
     fused = fused_kernels_active()
-    if fused:
-        from ..ops import pallas_point
 
     def step(acc, dig):
         entry = _gather_table(table, dig)
-        if fused:
-            return pallas_point.pt_window_step(cs, acc, entry, WINDOW), None
-        for _ in range(WINDOW):
-            acc = _double_xla(cs, acc)
-        return _add_xla(cs, acc, entry), None
+        return window_step(cs, acc, entry, WINDOW, fused), None
 
     init = identity(cs, p.shape[:-2])
     acc, _ = lax.scan(step, init, digits_rev)
@@ -783,6 +777,25 @@ def eval_point_poly(
 # ---------------------------------------------------------------------------
 
 
+def window_step(
+    cs: CurveSpec, acc: jax.Array, entry: jax.Array, window: int, fused: bool
+) -> jax.Array:
+    """One Straus window step: ``window`` doublings then add ``entry``.
+
+    THE single definition of the fused-vs-XLA dispatch shared by
+    :func:`msm`, :func:`_scalar_mul_core` and the ceremony point-RLC —
+    with the fused kernels active the whole step is one Pallas launch
+    (intermediates never touch HBM); otherwise plain XLA ops.
+    """
+    if fused:
+        from ..ops import pallas_point
+
+        return pallas_point.pt_window_step(cs, acc, entry, window)
+    for _ in range(window):
+        acc = _double_xla(cs, acc)
+    return _add_xla(cs, acc, entry)
+
+
 def _tree_reduce(cs: CurveSpec, pts: jax.Array, axis_len: int) -> jax.Array:
     """Pairwise point-add reduction over axis -3 (the m axis)."""
     m = axis_len
@@ -813,17 +826,11 @@ def msm(cs: CurveSpec, scalars: jax.Array, points: jax.Array) -> jax.Array:
     digits = scalar_windows(cs, scalars)  # (..., m, NW)
     digits_rev = jnp.moveaxis(digits, -1, 0)[::-1]  # (NW, ..., m)
     fused = fused_kernels_active()
-    if fused:
-        from ..ops import pallas_point
 
     def step(acc, dig):
         contribs = _gather_table(tables, dig)  # (..., m, C, L)
         total = _tree_reduce(cs, contribs, m)
-        if fused:
-            return pallas_point.pt_window_step(cs, acc, total, WINDOW), None
-        for _ in range(WINDOW):
-            acc = _double_xla(cs, acc)
-        return _add_xla(cs, acc, total), None
+        return window_step(cs, acc, total, WINDOW, fused), None
 
     init = identity(cs, points.shape[:-3])
     acc, _ = lax.scan(step, init, digits_rev)

@@ -10,8 +10,9 @@ one run per flag set isolates a regression:
 
     python scripts/profile_verify.py 256                     # defaults
     DKG_TPU_PALLAS=0 python scripts/profile_verify.py 256    # no fused kernels
-    DKG_TPU_PALLAS=0 DKG_TPU_MXU=0 DKG_TPU_FB_WINDOW=8 \
+    DKG_TPU_PALLAS=0 DKG_TPU_MXU=0 DKG_TPU_FB_WINDOW=8 DKG_TPU_RLC=bits \
         python scripts/profile_verify.py 256                 # round-1 config
+    DKG_TPU_RLC=straus|bits  # force the point-RLC schedule independently
 
 Per-stage wall-clocks print AS THEY COMPLETE (flush=True) — if a stage
 stalls, the last printed line names the culprit.  Stage list: table

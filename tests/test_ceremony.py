@@ -305,3 +305,37 @@ def test_run_blame_identifies_random_tamper_patterns():
         ), (trial, pairs)
         expect_qualified = [j not in dealers for j in range(8)]
         assert np.asarray(out["qualified"]).tolist() == expect_qualified, trial
+
+
+def test_point_rlc_schedules_agree_exactly():
+    """The Straus windowed schedule (XLA window step — the conservative
+    TPU configuration) and the bit-at-a-time ladder must produce the
+    SAME combined commitment columns (projectively equal points — the
+    schedules differ in Z scale): verify_batch's verdicts must not
+    depend on which schedule a platform selects."""
+    import os
+
+    c = ce.BatchedCeremony("ristretto255", 4, 1, b"rlc-sched", random.Random(5))
+    cfg = c.cfg
+    a, e, s, r = ce.deal(cfg, c.coeffs_a, c.coeffs_b, c.g_table, c.h_table)
+    rho = jnp.asarray(ce.derive_rho(cfg, a, e, s, r, 32))
+    prev = {k: os.environ.get(k) for k in ("DKG_TPU_RLC", "DKG_TPU_PALLAS")}
+    try:
+        # PALLAS=0 pins the XLA window step, so the straus leg covers
+        # the conservative-TPU path even on a machine with fused
+        # kernels active by default.
+        os.environ["DKG_TPU_PALLAS"] = "0"
+        os.environ["DKG_TPU_RLC"] = "bits"
+        d_bits = np.asarray(ce._point_rlc(cfg.cs, rho, e, 32))
+        os.environ["DKG_TPU_RLC"] = "straus"
+        d_straus = np.asarray(ce._point_rlc(cfg.cs, rho, e, 32))
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    g = c.group
+    cs = cfg.cs
+    for col_bits, col_straus in zip(gd.to_host(cs, d_bits), gd.to_host(cs, d_straus)):
+        assert g.eq(col_bits, col_straus)
