@@ -155,6 +155,11 @@ def _point_rlc(cs, weights: jax.Array, points: jax.Array, nbits: int) -> jax.Arr
 
     m = points.shape[0]
     mode = os.environ.get("DKG_TPU_RLC")
+    if mode not in (None, "straus", "bits"):
+        raise ValueError(
+            f"DKG_TPU_RLC={mode!r}: expected 'straus' or 'bits' "
+            "(a typo would silently measure the wrong schedule)"
+        )
     fused = gd.fused_kernels_active()
     use_straus = mode == "straus" or (mode is None and (fused or fd._on_tpu()))
     if use_straus:
@@ -367,8 +372,14 @@ def _dealer_rows_device(cfg: CeremonyConfig, a_comm, e_comm, shares, hidings):
     from ..crypto import device_hash as dh
 
     k = shares.shape[0]
-    rows_a = dh.row_digests(jnp.asarray(a_comm, jnp.uint32).reshape(k, -1), domain=1)
-    rows_e = dh.row_digests(jnp.asarray(e_comm, jnp.uint32).reshape(k, -1), domain=2)
+    # Commitments are digested in CANONICAL affine form: projective Z
+    # scale depends on the addition schedule (platform/flags), and rho
+    # must be a function of the logical transcript, not of which kernel
+    # computed it (gd.affine_canon's docstring has the full argument).
+    a_canon = gd.affine_canon(cfg.cs, jnp.asarray(a_comm))
+    e_canon = gd.affine_canon(cfg.cs, jnp.asarray(e_comm))
+    rows_a = dh.row_digests(jnp.asarray(a_canon, jnp.uint32).reshape(k, -1), domain=1)
+    rows_e = dh.row_digests(jnp.asarray(e_canon, jnp.uint32).reshape(k, -1), domain=2)
     sr = jnp.concatenate(
         [
             jnp.asarray(shares, jnp.uint32).reshape(k, -1),
@@ -418,7 +429,12 @@ def transcript_digest(cfg: CeremonyConfig, a_comm, e_comm, shares, hidings) -> b
     device family via :func:`derive_rho`'s default.
     """
     rows = _dealer_row_digests(np.asarray(shares), np.asarray(hidings))
-    return _fold_digest(cfg, np.asarray(a_comm), np.asarray(e_comm), rows)
+    # Same canonical-form discipline as the device digest family: the
+    # audit digest must agree for the same logical transcript no matter
+    # which schedule produced the projective coordinates.
+    a_canon = np.asarray(gd.affine_canon(cfg.cs, jnp.asarray(a_comm)))
+    e_canon = np.asarray(gd.affine_canon(cfg.cs, jnp.asarray(e_comm)))
+    return _fold_digest(cfg, a_canon, e_canon, rows)
 
 
 def sharded_transcript_digest(cfg: CeremonyConfig, a, e, s, r) -> bytes:

@@ -777,6 +777,50 @@ def eval_point_poly(
 # ---------------------------------------------------------------------------
 
 
+def affine_canon(cs: CurveSpec, pts: jax.Array) -> jax.Array:
+    """Canonical (affine, Z=1) limb representation of a point batch:
+    (..., C, L) -> (..., C, L) with X/Z, Y/Z (+ T = XY for Edwards);
+    zero-Z lanes map to the canonical identity ((0,1,0) Weierstrass).
+
+    Schedule-independent by construction: any operation order that
+    yields the same group element yields the same canonical limbs.
+    Transcript digests MUST hash this form — a Fiat-Shamir digest over
+    raw projective limbs would make rho depend on which addition
+    schedule (platform / feature flags) produced the commitments,
+    breaking cross-platform digest agreement for the same logical
+    ceremony.
+
+    One batched Montgomery-trick inversion over all lanes (short scan
+    axis, wide batch — same shape discipline as the table build).
+    """
+    f = cs.field
+    z = pts[..., 2, :]
+    z_is_zero = fd.is_zero(z)
+    z_safe = fd.select(z_is_zero, jnp.broadcast_to(fd.ones(f), z.shape), z)
+    flat = z_safe.reshape(-1, f.limbs)
+    n_lanes = flat.shape[0]
+    pad = (-n_lanes) % 256
+    if pad:
+        flat = jnp.concatenate(
+            [flat, jnp.broadcast_to(fd.ones(f), (pad, f.limbs))]
+        )
+    rows = 256 if flat.shape[0] >= 256 else 1
+    zi = fd.batch_inv(f, flat.reshape(rows, -1, f.limbs), axis=0)
+    zi = zi.reshape(-1, f.limbs)[:n_lanes].reshape(z.shape)
+    x_a = fd.mul(f, pts[..., 0, :], zi)
+    y_a = fd.mul(f, pts[..., 1, :], zi)
+    one = jnp.broadcast_to(fd.ones(f), x_a.shape)
+    if cs.kind == "edwards":
+        t_a = fd.mul(f, x_a, y_a)
+        out = jnp.stack([x_a, y_a, one, t_a], axis=-2)
+    else:
+        out = jnp.stack([x_a, y_a, one], axis=-2)
+    ident = identity(cs)
+    return jnp.where(
+        z_is_zero[..., None, None], jnp.broadcast_to(ident, out.shape), out
+    )
+
+
 def window_step(
     cs: CurveSpec, acc: jax.Array, entry: jax.Array, window: int, fused: bool
 ) -> jax.Array:

@@ -107,27 +107,43 @@ def test_batch_verify_all_pass_and_detects_cheat(ceremony):
 
 
 def test_fiat_shamir_binds_entire_transcript(ceremony):
-    """rho must change when ANY limb of ANY dealer's round-1 output
-    flips — the round-1 transcript digest covers every tensor in full,
-    closing the adaptive-dealer hole of a truncated transcript."""
+    """rho must change whenever the LOGICAL round-1 transcript changes —
+    any dealer's any commitment POINT (the digest hashes canonical
+    affine form), any delivered share limb — and must NOT change under
+    a projective rescale of the same points (platform/schedule
+    independence: gd.affine_canon's contract)."""
     c, out = ceremony
     cfg = c.cfg
+    pm = cfg.cs.field.modulus
     a = np.asarray(out["bare"])
     e = np.asarray(out["randomized"])
     s = np.asarray(out["shares"])
     r = np.asarray(out["hidings"])
     rho0 = ce.derive_rho(cfg, a, e, s, r, 64)
 
-    # flip one limb of the LAST dealer's LAST commitment coefficient —
-    # far beyond any truncation window
+    # change the LAST dealer's LAST commitment coefficient to a
+    # different group element (x-coordinate limb flip) — far beyond any
+    # truncation window
     e_bad = e.copy()
-    e_bad[-1, -1, -1, -1] ^= 1
+    e_bad[-1, -1, 0, 0] ^= 1
     assert not np.array_equal(ce.derive_rho(cfg, a, e_bad, s, r, 64), rho0)
 
     # the bare commitments feed the master key, so they are bound too
     a_bad = a.copy()
-    a_bad[-1, 0, -1, -1] ^= 1
+    a_bad[-1, 0, 0, 0] ^= 1
     assert not np.array_equal(ce.derive_rho(cfg, a_bad, e, s, r, 64), rho0)
+
+    # a projectively-rescaled (same group elements) commitment tensor
+    # must derive the IDENTICAL rho: the digest is a function of the
+    # logical transcript, not of which addition schedule produced it
+    z = 0xB00B5
+    e_host = gd.to_host(cfg.cs, e.reshape(-1, cfg.cs.ncoords, cfg.cs.field.limbs))
+    e_scaled = np.asarray(
+        gd.from_host(
+            cfg.cs, [tuple(c_ * z % pm for c_ in p) for p in e_host]
+        )
+    ).reshape(e.shape)
+    assert np.array_equal(ce.derive_rho(cfg, a, e_scaled, s, r, 64), rho0)
 
     # and the last dealer's last delivered share / hiding
     s_bad = s.copy()

@@ -189,3 +189,28 @@ def test_fixed_base_mul_identity_base(cs):
     )
     for pt in out:
         assert g.eq(pt, g.identity())
+
+
+@pytest.mark.parametrize("cs", CURVES, ids=CURVE_IDS)
+def test_affine_canon_is_representation_independent(cs):
+    """affine_canon maps every projective representation of a group
+    element to ONE canonical limb array (the transcript-digest
+    requirement: rho must not depend on which addition schedule
+    produced the commitments), and maps zero-Z lanes to the canonical
+    identity."""
+    g = hostg(cs)
+    pm = cs.field.modulus
+    pts, scaled = [], []
+    for _ in range(5):
+        p = g.scalar_mul_vartime(g.random_scalar(RNG), g.generator())
+        z = RNG.randrange(1, pm)
+        pts.append(p)
+        scaled.append(tuple(c * z % pm for c in p))
+    if cs.kind != "edwards":
+        pts.append(g.identity())
+        scaled.append((0, RNG.randrange(1, pm), 0))  # scaled identity rep
+    a = gd.affine_canon(cs, gd.from_host(cs, pts))
+    b = gd.affine_canon(cs, gd.from_host(cs, scaled))
+    assert (np.asarray(a) == np.asarray(b)).all()
+    for orig, canon in zip(pts, gd.to_host(cs, np.asarray(a))):
+        assert g.eq(orig, canon)
