@@ -51,14 +51,41 @@ def global_party_mesh() -> Mesh:
     return Mesh(np.asarray(jax.devices()), (PARTY_AXIS,))
 
 
-def process_party_block(n_parties: int) -> tuple[int, int]:
+def process_party_block(n_parties: int, mesh: Mesh | None = None) -> tuple[int, int]:
     """This process's contiguous party block [start, stop) under the
     party-axis sharding (for host-side per-party work like DEM sealing
-    that must track the device sharding)."""
-    n_dev = jax.device_count()
+    that must track the device sharding).
+
+    Derived from the devices' POSITIONS on the mesh's party axis — not
+    from raw device ids, which a runtime may hand out non-contiguously
+    or out of global order.  Mesh position p owns parties
+    [p·per_dev, (p+1)·per_dev).  Raises when this process's devices do
+    not form one contiguous run of positions (host-side per-party work
+    would then need a per-position split, not one block) — loud failure
+    instead of silently sealing the wrong parties' shares.
+    """
+    if mesh is not None:
+        if mesh.devices.ndim != 1 or mesh.axis_names != (PARTY_AXIS,):
+            raise ValueError(
+                f"expected a 1-D ({PARTY_AXIS!r},) mesh, got axes "
+                f"{mesh.axis_names} shape {mesh.devices.shape}: flat "
+                "positions would not correspond to party-axis coordinates"
+            )
+        devs = list(mesh.devices.flat)
+    else:
+        devs = jax.devices()
+    n_dev = len(devs)
+    if n_parties % n_dev:
+        raise ValueError(f"{n_parties} parties do not shard evenly over {n_dev} devices")
     per_dev = n_parties // n_dev
-    local = jax.local_devices()
-    ids = sorted(d.id for d in local)
-    start = ids[0] * per_dev
-    stop = (ids[-1] + 1) * per_dev
-    return start, stop
+    local_ids = {d.id for d in jax.local_devices()}
+    positions = sorted(i for i, d in enumerate(devs) if d.id in local_ids)
+    if not positions:
+        raise RuntimeError("this process owns no devices on the party mesh")
+    if positions != list(range(positions[0], positions[-1] + 1)):
+        raise RuntimeError(
+            "this process's devices sit at non-contiguous party-axis positions "
+            f"{positions}; lay the mesh out process-major (global_party_mesh "
+            "does) or split host-side work per position"
+        )
+    return positions[0] * per_dev, (positions[-1] + 1) * per_dev

@@ -91,6 +91,32 @@ def test_multihost_helpers_single_process():
     assert (start, stop) == (0, 16)
 
 
+def test_party_block_derives_from_mesh_positions(monkeypatch):
+    """The host-side party block follows the devices' POSITIONS on the
+    party axis, not their raw ids — and refuses non-contiguous layouts
+    loudly (silently sealing the wrong parties' shares is the failure
+    mode the round-2 review flagged)."""
+    import pytest as _pytest
+
+    from dkg_tpu.parallel import multihost
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    # a process owning devices at positions 2..3 of a permuted mesh
+    order = [devs[4], devs[5], devs[0], devs[1], devs[6], devs[7], devs[2], devs[3]]
+    mesh = Mesh(np.asarray(order), ("parties",))
+    monkeypatch.setattr(jax, "local_devices", lambda: [devs[0], devs[1]])
+    assert multihost.process_party_block(16, mesh) == (4, 8)
+    # the same devices at NON-contiguous positions must raise
+    order_bad = [devs[0], devs[4], devs[1], devs[5], devs[6], devs[7], devs[2], devs[3]]
+    mesh_bad = Mesh(np.asarray(order_bad), ("parties",))
+    with _pytest.raises(RuntimeError, match="non-contiguous"):
+        multihost.process_party_block(16, mesh_bad)
+    # uneven sharding is rejected up front
+    with _pytest.raises(ValueError, match="evenly"):
+        multihost.process_party_block(17, mesh)
+
+
 def test_sharded_blame_disqualifies_cheating_dealer():
     """An injected cheat on the mesh drops the ceremony into
     sharded_blame: the guilty dealer is disqualified on every shard and
@@ -180,3 +206,14 @@ def test_multihost_two_process_smoke():
         timeout=2400,
     )
     assert rc == 0
+
+
+def test_party_block_rejects_multi_axis_mesh():
+    """A multi-axis mesh must be rejected: flat positions would not map
+    to party-axis coordinates."""
+    from dkg_tpu.parallel import multihost
+    from jax.sharding import Mesh
+
+    devs = np.asarray(jax.devices()).reshape(2, 4)
+    with pytest.raises(ValueError, match="1-D"):
+        multihost.process_party_block(16, Mesh(devs, ("replicas", "parties")))
