@@ -38,4 +38,31 @@ assert "error" not in out, out.get("error")
 assert bool(np.asarray(out["ok"]).all())
 for name, span in trace.timings_s.items():
     print(f"{name:10s} {span:8.3f}s", flush=True)
-print("OK", flush=True)
+
+# Artifact for the record (BLS_SMOKE.json at the repo root): BASELINE
+# config 5 evidence, keyed per backend+shape so a TPU run ADDS to the
+# CPU record instead of clobbering it.
+import json
+import pathlib
+
+_ARTIFACT = pathlib.Path(__file__).resolve().parent.parent / "BLS_SMOKE.json"
+report = {
+    "curve": "bls12_381_g1",
+    "n": n,
+    "t": t,
+    "platform": jax.devices()[0].platform,
+    "phases_s": {k: round(v, 3) for k, v in trace.timings_s.items()},
+    "pairs_per_sec": round(
+        n * (n - 1) / trace.timings_s["verify"], 1
+    ) if trace.timings_s.get("verify") else None,
+    "all_verified": bool(np.asarray(out["ok"]).all()),
+}
+try:
+    records = json.loads(_ARTIFACT.read_text())
+    if not isinstance(records, dict):
+        records = {}
+except (OSError, ValueError):
+    records = {}
+records[f"{report['platform']}_n{n}_t{t}"] = report
+_ARTIFACT.write_text(json.dumps(records, indent=1))
+print(json.dumps(report), flush=True)
