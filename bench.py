@@ -271,9 +271,9 @@ def _rung_child(curve: str, n: int, t: int) -> None:
     print(
         json.dumps(
             {
-                "deal_s": round(t_deal, 3),
-                "verify_s": round(t_verify, 3),
-                "fiat_shamir_s": round(t_rho, 3),
+                "deal_s": round(t_deal, 6),
+                "verify_s": round(t_verify, 6),
+                "fiat_shamir_s": round(t_rho, 6),
                 "pallas": _pallas_active(),
             }
         )
@@ -375,7 +375,11 @@ def _init_platform() -> str | None:
         import pathlib
 
         os.environ["JAX_PLATFORMS"] = "cpu"
-        os.environ["PYTHONPATH"] = str(pathlib.Path(__file__).parent)
+        repo = str(pathlib.Path(__file__).parent)
+        existing = os.environ.get("PYTHONPATH")
+        os.environ["PYTHONPATH"] = (
+            os.pathsep.join([repo, existing]) if existing else repo
+        )
         os.execv(sys.executable, [sys.executable, __file__])
     _import_jax()
     # parity_check needs a CPU backend next to the TPU one; the ambient
@@ -467,7 +471,10 @@ def main():
             print(f"bench config {curve} n={n} failed", file=sys.stderr)
             continue
         pairs = n * (n - 1)
-        rate = pairs / res["verify_s"]
+        # max() guard: a sub-microsecond verify (or a child that rounded
+        # to 0.0) must degrade to a huge-but-finite rate, not crash main()
+        # before the always-emitted JSON line.
+        rate = pairs / max(res["verify_s"], 1e-6)
         # On TPU this is the real cross-device bit-exactness bit; on CPU
         # it still cross-checks the fused-kernel path against the
         # independent pure-XLA formulation.  Runs under the winning

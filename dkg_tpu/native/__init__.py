@@ -13,7 +13,6 @@ framework runs unchanged on hosts without a toolchain.
 from __future__ import annotations
 
 import ctypes
-import os
 import pathlib
 import subprocess
 from typing import Optional

@@ -19,7 +19,7 @@ import socketserver
 import struct
 import threading
 import time
-from typing import Optional, Protocol
+from typing import Protocol
 
 _OP_PUB = 1
 _OP_FETCH = 2

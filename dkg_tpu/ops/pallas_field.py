@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..fields.spec import FieldSpec, int_to_limbs
+from ..fields.spec import FieldSpec
 
 BLOCK = 128  # lane width: one VPU register row of batch elements
 

@@ -27,7 +27,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..groups.device import CurveSpec
-from . import pallas_field as pfk
 from .pallas_field import BLOCK, mod_add_rows, mod_mul_rows, mod_sub_rows
 
 try:

@@ -58,7 +58,6 @@ def _shard_map_nocheck(f, *, mesh, in_specs, out_specs):
     )
 
 from ..dkg import ceremony as ce
-from ..fields import device as fd
 from ..groups import device as gd
 from jax import lax
 

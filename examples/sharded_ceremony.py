@@ -22,8 +22,6 @@ from dkg_tpu.parallel.hostmesh import force_cpu_mesh
 N_DEVICES = 8
 force_cpu_mesh(N_DEVICES)  # no-op if 8 real devices already exist
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from dkg_tpu.dkg import ceremony as ce

@@ -29,29 +29,50 @@ struct FieldCtx {
 
 // ---------------------------------------------------------------- helpers
 
-static inline int geq(const uint64_t* a, const uint64_t* b, int n) {
-    for (int i = n - 1; i >= 0; --i) {
-        if (a[i] != b[i]) return a[i] > b[i];
+// All helpers below are branchless on limb VALUES: carries/borrows are
+// carried as arithmetic 0/1 words (no data-dependent control flow), the
+// compare runs over every limb (no early exit), and conditional
+// reductions are masked subtracts.  Loop bounds depend only on the limb
+// count, so the ct ladders inherit a value-independent operation
+// sequence end to end.
+
+// 1 iff a >= b over n limbs (constant-time: full borrow chain, no exit)
+static inline uint64_t geq_ct(const uint64_t* a, const uint64_t* b, int n) {
+    uint64_t borrow = 0;
+    for (int i = 0; i < n; ++i) {
+        u128 d = (u128)a[i] - b[i] - borrow;
+        borrow = (uint64_t)(d >> 64) & 1;
     }
-    return 1;
+    return 1 - borrow;
 }
 
 static inline void sub_n(uint64_t* a, const uint64_t* b, int n) {
-    unsigned char borrow = 0;
+    uint64_t borrow = 0;
     for (int i = 0; i < n; ++i) {
-        uint64_t bi = b[i] + borrow;
-        unsigned char nb = (bi < b[i]) || (a[i] < bi);
-        a[i] -= bi;
-        borrow = nb;
+        u128 d = (u128)a[i] - b[i] - borrow;
+        a[i] = (uint64_t)d;
+        borrow = (uint64_t)(d >> 64) & 1;
     }
 }
 
 static inline void add_n(uint64_t* a, const uint64_t* b, int n) {
-    unsigned char carry = 0;
+    uint64_t carry = 0;
     for (int i = 0; i < n; ++i) {
-        uint64_t s = a[i] + b[i] + carry;
-        carry = carry ? (s <= a[i]) : (s < a[i]);
-        a[i] = s;
+        u128 s = (u128)a[i] + b[i] + carry;
+        a[i] = (uint64_t)s;
+        carry = (uint64_t)(s >> 64);
+    }
+}
+
+// a -= p if cond (branchless masked subtract; cond is 0 or 1)
+static inline void cond_sub(uint64_t* a, const uint64_t* p, int n,
+                            uint64_t cond) {
+    const uint64_t mask = (uint64_t)0 - cond;
+    uint64_t borrow = 0;
+    for (int i = 0; i < n; ++i) {
+        u128 d = (u128)a[i] - (p[i] & mask) - borrow;
+        a[i] = (uint64_t)d;
+        borrow = (uint64_t)(d >> 64) & 1;
     }
 }
 
@@ -86,10 +107,10 @@ static void barrett(const FieldCtx* c, const uint64_t* x, uint64_t* out) {
     uint64_t q3p[2 * MAXL + 3];
     mul_wide(q3, L + 1, c->p, L + 1, q3p);
     sub_n(r, q3p, L + 1);  // wraparound == + b^(L+1), same as device path
-    // at most two conditional subtractions of p (p has L+1 limbs w/ pad)
-    for (int k = 0; k < 2; ++k) {
-        if (geq(r, c->p, L + 1)) sub_n(r, c->p, L + 1);
-    }
+    // at most two conditional subtractions of p (p has L+1 limbs w/ pad),
+    // always executed as masked subtracts so the op sequence is fixed
+    for (int k = 0; k < 2; ++k)
+        cond_sub(r, c->p, L + 1, geq_ct(r, c->p, L + 1));
     for (int i = 0; i < L; ++i) out[i] = r[i];
 }
 
@@ -111,7 +132,7 @@ static void f_add_one(const FieldCtx* c, const uint64_t* a, const uint64_t* b,
     for (int i = 0; i < L; ++i) bb[i] = b[i];
     bb[L] = 0;
     add_n(s, bb, L + 1);
-    if (geq(s, c->p, L + 1)) sub_n(s, c->p, L + 1);
+    cond_sub(s, c->p, L + 1, geq_ct(s, c->p, L + 1));
     for (int i = 0; i < L; ++i) out[i] = s[i];
 }
 
@@ -126,7 +147,7 @@ static void f_sub_one(const FieldCtx* c, const uint64_t* a, const uint64_t* b,
     for (int i = 0; i < L; ++i) bb[i] = b[i];
     bb[L] = 0;
     sub_n(s, bb, L + 1);
-    if (geq(s, c->p, L + 1)) sub_n(s, c->p, L + 1);
+    cond_sub(s, c->p, L + 1, geq_ct(s, c->p, L + 1));
     for (int i = 0; i < L; ++i) out[i] = s[i];
 }
 
@@ -244,9 +265,12 @@ void ed_scalar_mul_batch(const EdCtx* c, const uint64_t* scalars,
 // Secret-scalar path: iteration count is the caller-supplied nbits (the
 // scalar field's bit length) regardless of the value, and every
 // iteration performs exactly one cswap + one add + one double + one
-// cswap.  The swap itself is a branchless masked exchange, so neither
-// the operation sequence nor the memory-access pattern depends on the
-// scalar — unlike ed_scalar_mul_batch above (vartime, public data only).
+// cswap.  The swap is a branchless masked exchange, and the underlying
+// field helpers (geq_ct/cond_sub/add_n/sub_n above) carry borrows as
+// arithmetic words with no early exits, so neither the operation
+// sequence nor the memory-access pattern depends on the scalar OR on
+// intermediate limb values — unlike ed_scalar_mul_batch above (vartime,
+// public data only; f_pow likewise branches on its public exponent).
 // Mirrors the op-for-op sequence of HostGroup.scalar_mul
 // (dkg_tpu/groups/host.py) so outputs are limb-exact identical.
 static inline void cswap_limbs(uint64_t* a, uint64_t* b, int n, uint64_t bit) {

@@ -1,0 +1,191 @@
+"""AST-based lint gate for environments without ruff.
+
+CI runs the real pinned ruff/mypy as BLOCKING jobs (.github/workflows/
+ci.yml — reference parity with clippy --deny warnings,
+/root/reference/.github/workflows/ci.yml:33-40).  This module enforces
+the deterministic core of that ruleset locally (the dev image carries no
+linter), so the committed baseline stays clean between CI runs:
+
+* F401  unused import (module scope; honours __all__ and redundant
+        ``import x as x`` re-export aliases)
+* F541  f-string without placeholders
+* E711  comparison to None with ==/!=
+* E712  comparison to True/False with ==/!=
+* E722  bare ``except:``
+* B006  mutable default argument
+* F632  ``is`` comparison with a literal
+
+Exit 0 = clean.  Run: ``python scripts/lint_lite.py`` (from repo root).
+Also executed by tests/test_import_hygiene.py so the default test tier
+blocks on regressions exactly like CI does.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+TARGETS = ["dkg_tpu", "tests", "examples", "scripts", "bench.py", "__graft_entry__.py"]
+
+
+def _iter_files() -> list[pathlib.Path]:
+    out: list[pathlib.Path] = []
+    for t in TARGETS:
+        p = REPO / t
+        if p.is_file():
+            out.append(p)
+        else:
+            out.extend(sorted(p.rglob("*.py")))
+    return out
+
+
+class _Checker(ast.NodeVisitor):
+    def __init__(self, path: pathlib.Path, tree: ast.Module, source: str):
+        self.path = path
+        self.problems: list[tuple[int, str, str]] = []
+        self.used_names: set[str] = set()
+        self.imports: list[tuple[int, str, str, bool]] = []  # line, local, code, reexport
+        self.dunder_all: set[str] = set()
+        self._source_lines = source.splitlines()
+        self._collect_all(tree)
+        self.visit(tree)
+
+    def _noqa(self, line: int) -> bool:
+        idx = line - 1
+        return 0 <= idx < len(self._source_lines) and "noqa" in self._source_lines[idx]
+
+    def _add(self, node: ast.AST, code: str, msg: str) -> None:
+        line = getattr(node, "lineno", 0)
+        if not self._noqa(line):
+            self.problems.append((line, code, msg))
+
+    def _collect_all(self, tree: ast.Module) -> None:
+        for node in tree.body:
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                if any(isinstance(t, ast.Name) and t.id == "__all__" for t in targets):
+                    val = node.value
+                    if isinstance(val, (ast.List, ast.Tuple)):
+                        for elt in val.elts:
+                            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                                self.dunder_all.add(elt.value)
+
+    # -- name usage ----------------------------------------------------
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self.used_names.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        # track the root name of dotted access (``pkg.mod.attr`` uses pkg)
+        self.generic_visit(node)
+
+    # -- imports -------------------------------------------------------
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            local = (alias.asname or alias.name).split(".")[0]
+            reexport = alias.asname is not None and alias.asname == alias.name
+            self.imports.append((node.lineno, local, "F401", reexport))
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            local = alias.asname or alias.name
+            reexport = alias.asname is not None and alias.asname == alias.name
+            self.imports.append((node.lineno, local, "F401", reexport))
+        self.generic_visit(node)
+
+    # -- rules ---------------------------------------------------------
+    def visit_Compare(self, node: ast.Compare) -> None:
+        for op, comp in zip(node.ops, node.comparators):
+            if isinstance(op, (ast.Eq, ast.NotEq)):
+                if isinstance(comp, ast.Constant) and comp.value is None:
+                    self._add(node, "E711", "comparison to None with ==/!=; use is")
+                elif isinstance(comp, ast.Constant) and isinstance(comp.value, bool):
+                    self._add(node, "E712", "comparison to True/False with ==/!=")
+            if isinstance(op, (ast.Is, ast.IsNot)):
+                if isinstance(comp, ast.Constant) and not isinstance(
+                    comp.value, (bool, type(None), type(...))
+                ):
+                    self._add(node, "F632", "is comparison with a literal")
+        self.generic_visit(node)
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self._add(node, "E722", "bare except")
+        self.generic_visit(node)
+
+    def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
+        if not any(isinstance(v, ast.FormattedValue) for v in node.values):
+            self._add(node, "F541", "f-string without placeholders")
+        # visit interpolated expressions (and any dynamic format specs,
+        # which can use names) — but not the spec JoinedStr itself: a
+        # format spec ("{x:8.3f}") must not be treated as an f-string
+        for v in node.values:
+            if isinstance(v, ast.FormattedValue):
+                self.visit(v.value)
+                if v.format_spec is not None:
+                    for sub in v.format_spec.values:
+                        if isinstance(sub, ast.FormattedValue):
+                            self.visit(sub.value)
+
+    def _check_defaults(self, node: ast.FunctionDef | ast.AsyncFunctionDef) -> None:
+        for default in list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None
+        ]:
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)) or (
+                isinstance(default, ast.Call)
+                and isinstance(default.func, ast.Name)
+                and default.func.id in ("list", "dict", "set")
+            ):
+                self._add(default, "B006", f"mutable default argument in {node.name}()")
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    # -- finalize ------------------------------------------------------
+    def finish(self) -> list[tuple[int, str, str]]:
+        for line, local, code, reexport in self.imports:
+            if reexport or local in self.dunder_all or local in self.used_names:
+                continue
+            if local == "annotations":  # from __future__ import annotations
+                continue
+            if self._noqa(line):
+                continue
+            # conftest/fixture side-effect imports are conventional
+            if self.path.name == "conftest.py":
+                continue
+            self.problems.append((line, code, f"unused import: {local}"))
+        return sorted(self.problems)
+
+
+def run() -> int:
+    bad = 0
+    for path in _iter_files():
+        source = path.read_text()
+        try:
+            tree = ast.parse(source, filename=str(path))
+        except SyntaxError as exc:  # E9 tier
+            print(f"{path}:{exc.lineno}: E999 {exc.msg}")
+            bad += 1
+            continue
+        for line, code, msg in _Checker(path, tree, source).finish():
+            print(f"{path.relative_to(REPO)}:{line}: {code} {msg}")
+            bad += 1
+    return bad
+
+
+if __name__ == "__main__":
+    n = run()
+    if n:
+        print(f"\n{n} problem(s)", file=sys.stderr)
+    sys.exit(1 if n else 0)

@@ -75,12 +75,15 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dkg_tpu.dkg import ceremony as ce
-from dkg_tpu.groups import device as gd
 from dkg_tpu.parallel import mesh as pmesh
 
-# HLO ops that move data between shards.
+# HLO ops that move data between shards.  Replication detection errs
+# broad: reduce-scatter and collective-broadcast are included even though
+# the current lowering never emits them near E, so a future lowering
+# change can't silently slip past the never_replicates_e guard.
 _COLLECTIVE_OP_RE = re.compile(
-    r"\b(all-gather|all-to-all|all-reduce|collective-permute)(?:-start)?\("
+    r"\b(all-gather|all-to-all|all-reduce|collective-permute"
+    r"|reduce-scatter|collective-broadcast)(?:-start)?\("
 )
 _SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
 

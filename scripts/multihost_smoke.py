@@ -37,7 +37,6 @@ def child(pid: int, coordinator: str) -> None:
     )
     import random
 
-    import jax.numpy as jnp
     import numpy as np
 
     from dkg_tpu.dkg import ceremony as ce

@@ -35,7 +35,6 @@ jax.config.update("jax_compilation_cache_dir", "/tmp/dkg_tpu_jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 from dkg_tpu.dkg import ceremony as ce
-from dkg_tpu.fields import device as fd
 from dkg_tpu.groups import device as gd
 
 N, T = int(sys.argv[1]) if len(sys.argv) > 1 else 1024, None

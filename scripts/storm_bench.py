@@ -15,8 +15,14 @@ ProofOfMisbehaviour; one additional FALSE accusation checks the court
 still rejects under load.  The reported rate is upheld-verified
 complaints per second through the batch court.
 
+A time-boxed serial court (per-complaint ``MisbehavingPartiesRound1
+.verify``, the reference's loop) runs after the batch court as the
+baseline, and the two verdict lists are cross-checked.
+
 Writes STORM.json at the repo root:  {n, t, k, platform,
-complaint_gen_s, adjudicate_s, complaints_per_sec, verdicts_ok}.
+complaint_gen_s, adjudicate_s, adjudicate_breakdown_s,
+complaints_per_sec, serial_complaints_per_sec,
+batch_vs_serial_speedup, serial_verdicts_match, verdicts_ok}.
 
 Usage: python scripts/storm_bench.py [--n 1024] [--t 341] [--curve ristretto255]
 """
@@ -94,6 +100,12 @@ def main() -> None:
     ap.add_argument("--n", type=int, default=1024)
     ap.add_argument("--t", type=int, default=341)
     ap.add_argument("--curve", default="ristretto255")
+    ap.add_argument(
+        "--serial-budget",
+        default=120.0,
+        type=float,
+        help="max seconds for the serial-baseline court (extrapolated beyond)",
+    )
     ap.add_argument("--out", default=str(pathlib.Path(__file__).parent.parent / "STORM.json"))
     args = ap.parse_args()
 
@@ -123,11 +135,35 @@ def main() -> None:
     # warm the device kernels at the REAL batch shape (jit caches per
     # shape) so the timed run measures steady-state adjudication
     cb.adjudicate_round1_batch(group, cs, env.commitment_key, triples, by_sender)
+    timings: dict = {}
     t0 = time.perf_counter()
-    verdicts = cb.adjudicate_round1_batch(group, cs, env.commitment_key, triples, by_sender)
+    verdicts = cb.adjudicate_round1_batch(
+        group, cs, env.commitment_key, triples, by_sender, timings=timings
+    )
     adj_s = time.perf_counter() - t0
 
+    # Serial reference-style court (one MisbehavingPartiesRound1.verify
+    # per complaint, the reference's loop broadcast.rs:50-98 /
+    # committee.rs:369-398): the baseline the batch court must beat.
+    # Time-boxed — serial host adjudication at storm scale can be
+    # minutes; extrapolate from the complaints actually adjudicated.
+    serial_budget_s = float(args.serial_budget)
+    serial_done = 0
+    serial_verdicts = []
+    t0 = time.perf_counter()
+    for accuser_idx, accuser_pk, m in triples:
+        serial_verdicts.append(
+            m.verify(group, env.commitment_key, accuser_idx, accuser_pk, tampered)
+        )
+        serial_done += 1
+        if time.perf_counter() - t0 > serial_budget_s:
+            break
+    serial_s = time.perf_counter() - t0
+    serial_rate = serial_done / serial_s if serial_s > 0 else 0.0
+    serial_ok = serial_verdicts == verdicts[:serial_done]
+
     ok = all(verdicts[:-1]) and not verdicts[-1]
+    batch_rate = len(triples) / adj_s
     report = {
         "n": n,
         "t": t,
@@ -137,7 +173,15 @@ def main() -> None:
         "deal_s": round(deal_s, 3),
         "complaint_gen_s": round(gen_s, 3),
         "adjudicate_s": round(adj_s, 3),
-        "complaints_per_sec": round(len(triples) / adj_s, 1),
+        "adjudicate_breakdown_s": {k_: round(v, 3) for k_, v in timings.items()},
+        "complaints_per_sec": round(batch_rate, 1),
+        "serial_adjudicated": serial_done,
+        "serial_s": round(serial_s, 3),
+        "serial_complaints_per_sec": round(serial_rate, 2),
+        "batch_vs_serial_speedup": round(batch_rate / serial_rate, 1)
+        if serial_rate
+        else None,
+        "serial_verdicts_match": serial_ok,
         "verdicts_ok": ok,
     }
     with open(args.out, "w") as f:

@@ -13,7 +13,6 @@ import pytest
 
 import jax.numpy as jnp
 
-from dkg_tpu.crypto import commitment as cmt
 from dkg_tpu.dkg import ceremony as ce
 from dkg_tpu.fields import host as fh
 from dkg_tpu.groups import device as gd

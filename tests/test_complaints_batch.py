@@ -3,7 +3,6 @@
 import random
 from dataclasses import replace
 
-import numpy as np
 
 from dkg_tpu.crypto.commitment import CommitmentKey
 from dkg_tpu.dkg import complaints_batch as cb

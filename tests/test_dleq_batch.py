@@ -2,7 +2,6 @@
 
 import random
 
-import numpy as np
 
 from dkg_tpu.crypto.dleq import DleqZkp
 from dkg_tpu.crypto import dleq_batch as db

@@ -12,7 +12,6 @@ import pytest
 
 import jax.numpy as jnp
 
-from dkg_tpu.fields import device as fd
 from dkg_tpu.fields import host as fh
 from dkg_tpu.fields import matmul as fmm
 from dkg_tpu.fields.spec import ALL_FIELDS

@@ -29,6 +29,24 @@ def test_package_import_initialises_no_backend():
     assert "clean" in out.stdout
 
 
+def test_lint_lite_clean():
+    """The AST lint gate (scripts/lint_lite.py) stays clean.
+
+    CI's blocking ruff/mypy jobs are the authoritative gate (reference
+    parity: clippy --deny warnings); this keeps the committed baseline
+    lint-clean from inside the default test tier, since the dev image
+    has no linter installed.
+    """
+    import pathlib
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "scripts"))
+    try:
+        import lint_lite
+    finally:
+        sys.path.pop(0)
+    assert lint_lite.run() == 0, "lint_lite found problems (see stdout)"
+
+
 def test_hostmesh_import_is_lightweight():
     # The driver image's sitecustomize preloads jax itself, so "jax not
     # in sys.modules" is unattainable; assert the real invariants: no

@@ -6,7 +6,6 @@ optional accelerator, never a correctness dependency).
 
 import random
 
-import numpy as np
 import pytest
 
 from dkg_tpu import native
