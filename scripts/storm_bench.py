@@ -178,10 +178,14 @@ def main() -> None:
         "serial_adjudicated": serial_done,
         "serial_s": round(serial_s, 3),
         "serial_complaints_per_sec": round(serial_rate, 2),
-        "batch_vs_serial_speedup": round(batch_rate / serial_rate, 1)
+        "batch_vs_serial_speedup": round(batch_rate / serial_rate, 3)
         if serial_rate
         else None,
         "serial_verdicts_match": serial_ok,
+        # what complaints_batch.adjudicate_round1 would pick here
+        "dispatcher_court": "serial"
+        if jax.default_backend() == "cpu"
+        else "batch",
         "verdicts_ok": ok,
     }
     with open(args.out, "w") as f:

@@ -80,6 +80,12 @@ def test_batch_matches_serial_verdicts():
     batch = cb.adjudicate_round1_batch(G, CS, env.commitment_key, triples, by_sender)
     assert batch == serial == [True, False, False]
 
+    # the serial court helper and the backend dispatcher agree too (the
+    # test backend is CPU, so the dispatcher must pick the serial court
+    # — the measured-faster one there, see STORM.json)
+    assert cb.adjudicate_round1_serial(G, env.commitment_key, triples, by_sender) == serial
+    assert cb.adjudicate_round1(G, CS, env.commitment_key, triples, by_sender) == serial
+
 
 def test_check_randomized_shares_batch_empty():
     ck = CommitmentKey.generate(G, b"x")
