@@ -401,11 +401,13 @@ def _init_platform() -> str | None:
             main_file = getattr(__main__, "__file__", None)  # "<stdin>" etc.
             if main_file and os.path.exists(main_file):
                 cmd = [sys.executable, main_file] + sys.argv[1:]
-            elif me:
-                cmd = [sys.executable, me]
             else:
-                # nothing on disk to re-exec (stdin-run bench, dead
-                # tunnel): emit the always-emit artifact line and stop
+                # Nothing on disk to re-exec (a stdin-run script, dead
+                # tunnel).  Do NOT guess bench.py here: any OTHER script
+                # routing through this helper (e.g. a stdin-run
+                # kem_bench) would be silently replaced by a full bench
+                # run — the wrong artifact is worse than no artifact.
+                # Emit the always-emit line and stop.
                 print(
                     json.dumps(
                         {
@@ -487,8 +489,13 @@ def main():
         "DKG_TPU_RLC": "bits",
     }
     if platform == "tpu":
+        # Middle rung: host-built 8-bit tables with every OTHER fast
+        # path on — isolates the device table build (the round-4 stall)
+        # from the fused-kernel/MXU wins, so a table-build failure
+        # still yields a fast-path measurement.
         ladder = [
             ("secp256k1", 1024, 341, {}, 1500.0),
+            ("secp256k1", 1024, 341, {"DKG_TPU_FB_WINDOW": "8"}, 1200.0),
             ("secp256k1", 1024, 341, conservative, 900.0),
             ("secp256k1", 256, 85, conservative, 600.0),
         ]

@@ -160,8 +160,10 @@ def _point_rlc(cs, weights: jax.Array, points: jax.Array, nbits: int) -> jax.Arr
             f"DKG_TPU_RLC={mode!r}: expected 'straus' or 'bits' "
             "(a typo would silently measure the wrong schedule)"
         )
-    fused = gd.fused_kernels_active()
-    use_straus = mode == "straus" or (mode is None and (fused or fd._on_tpu()))
+    fused = gd.fused_multi_active(cs)
+    use_straus = mode == "straus" or (
+        mode is None and (gd.fused_kernels_active() or fd._on_tpu())
+    )
     if use_straus:
         if points.ndim > 3:
             # Chunk the first trailing batch axis so the per-point

@@ -15,7 +15,7 @@ upheld/rejected verdicts per complaint.
 
 Measured reality (STORM.json): the batch court wins only when ladders
 run wide on an accelerator; on a 1-core CPU backend the serial host
-court (native C++ ladder) is ~26x faster.  Callers should therefore go
+court (native C++ ladder) is ~25x faster.  Callers should therefore go
 through :func:`adjudicate_round1`, which routes by active backend.
 """
 
@@ -126,8 +126,8 @@ def adjudicate_round1(
 
     The batched device court only pays when the ladders run wide on an
     accelerator; on a CPU backend the XLA limb arithmetic serialises
-    and the host court with the native C++ ladder wins by ~26x at a
-    t-sized storm (STORM.json, n=256 t=85: 34.0/s serial host vs 1.3/s
+    and the host court with the native C++ ladder wins by ~25x at a
+    t-sized storm (STORM.json, n=256 t=85: 37.75/s serial host vs 1.5/s
     batched XLA:CPU).  Verdicts are identical either way (tested), so
     route by the active backend.
 

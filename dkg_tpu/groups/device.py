@@ -46,6 +46,33 @@ WINDOW = 4  # window bits for scalar decomposition (16-entry tables)
 fused_kernels_active = fd.fused_kernels_active
 
 
+def fused_multi_active(cs: "CurveSpec") -> bool:
+    """Whether MULTI-op fused kernels (the n-double window step and the
+    small-scalar ladder, ops/pallas_point.py) are dispatched.
+
+    Single-op fused kernels (add/madd/double) compile for every curve,
+    but Mosaic never returned from compiling the multi-op EDWARDS body
+    on v5e (round 4: ristretto255 pt_window_step still compiling when
+    hard-killed at ~870 s, while the same Weierstrass body compiled in
+    77 s) — so Edwards composes single-op kernels via XLA instead.
+    DKG_TPU_FUSED_MULTI=1/0 forces either way (1 still requires the
+    fused kernels to be active at all).
+    """
+    import os
+
+    env = os.environ.get("DKG_TPU_FUSED_MULTI")
+    if env not in (None, "0", "1"):
+        raise ValueError(
+            f"DKG_TPU_FUSED_MULTI={env!r}: expected '0' or '1' (a typo "
+            "would silently run the wrong kernel path)"
+        )
+    if env == "0":
+        return False
+    if env == "1":
+        return fused_kernels_active()
+    return fused_kernels_active() and cs.kind != "edwards"
+
+
 def _jit_static0(fn):
     """jit with the CurveSpec (hashable, frozen) as a static argument."""
     return jax.jit(fn, static_argnums=0)
@@ -485,7 +512,7 @@ def _scalar_mul_core(cs: CurveSpec, k: jax.Array, p: jax.Array) -> jax.Array:
     table = _build_table(cs, p)
     digits = scalar_windows(cs, k)  # (..., NW)
     digits_rev = jnp.moveaxis(digits, -1, 0)[::-1]  # MSB first
-    fused = fused_kernels_active()
+    fused = fused_multi_active(cs)
 
     def step(acc, dig):
         entry = _gather_table(table, dig)
@@ -591,9 +618,11 @@ def fixed_base_table_dev(cs: CurveSpec, base, window: int = 16) -> jax.Array:
     T[w][d] = d * (2**window)^w * B, affine-normalised (Z = 1) like the
     host table, with the same identity convention for entry 0 (Edwards
     (0,1,1,0) — genuinely affine; Weierstrass (0,1,0) — masked by the
-    digit-0 select in _fixed_base_mul_core).  Built as one batched
-    ladder per window base + a single Montgomery-trick inversion over
-    all entries; cached per (curve, base, window).
+    digit-0 select in _fixed_base_mul_core).  Narrow windows (<= 8 bits)
+    build as one batched ladder per window base; wide windows COMPOSE
+    two half-width host-table entries with one batched add (see
+    _compose_table_dev).  Both end in a single Montgomery-trick
+    inversion over all entries; cached per (curve, base, window).
     """
     return _fixed_table_dev_cached(cs, base_key(cs, base), window)
 
@@ -601,6 +630,8 @@ def fixed_base_table_dev(cs: CurveSpec, base, window: int = 16) -> jax.Array:
 @functools.lru_cache(maxsize=8)
 def _fixed_table_dev_cached(cs: CurveSpec, key: tuple, window: int) -> jax.Array:
     f = cs.field
+    if window > 8:
+        return affine_canon(cs, _compose_table_dev(cs, key, window))
     host_group = gh.ALL_GROUPS[cs.name]
     base = base_key_to_point(cs, key)
     nw = _n_windows(cs, window)
@@ -620,30 +651,31 @@ def _fixed_table_dev_cached(cs: CurveSpec, key: tuple, window: int) -> jax.Array
         cs, digits, jnp.broadcast_to(bases_dev[:, None], (nw, entries, cs.ncoords, f.limbs)),
         window,
     )  # (nw, entries, C, L) projective
-    # affine-normalise with ONE batched inversion; zero-Z lanes (the
-    # Weierstrass identity at digit 0) are guarded then overwritten
-    z = pts[..., 2, :]
-    z_is_zero = fd.is_zero(z)
-    z_safe = fd.select(z_is_zero, jnp.broadcast_to(fd.ones(f), z.shape), z)
-    # Montgomery trick with a SHORT scan axis (256) and everything else
-    # batched wide — a flat scan over nw * 2**window lanes would
-    # serialize ~1M multiply steps
-    flat = z_safe.reshape(-1, f.limbs)
-    rows = 256 if flat.shape[0] % 256 == 0 else 1
-    zi = fd.batch_inv(f, flat.reshape(rows, -1, f.limbs), axis=0).reshape(z.shape)
-    x_a = fd.mul(f, pts[..., 0, :], zi)
-    y_a = fd.mul(f, pts[..., 1, :], zi)
-    one = jnp.broadcast_to(fd.ones(f), x_a.shape)
-    if cs.kind == "edwards":
-        t_a = fd.mul(f, x_a, y_a)
-        out = jnp.stack([x_a, y_a, one, t_a], axis=-2)
-    else:
-        out = jnp.stack([x_a, y_a, one], axis=-2)
-        ident = identity(cs)  # (C, L): (0, 1, 0)
-        out = jnp.where(
-            z_is_zero[..., None, None], jnp.broadcast_to(ident, out.shape), out
-        )
-    return out
+    return affine_canon(cs, pts)
+
+
+def _compose_table_dev(cs: CurveSpec, key: tuple, window: int) -> jax.Array:
+    """Wide-window table entries by COMPOSITION, not a device ladder.
+
+    With the cheap host-built half-width table T[v][e] = e·(2**h)^v·B
+    (h = window/2), every wide entry d = lo + 2**h·hi is
+    ``T[2w][lo] + T[2w+1][hi]`` — ONE complete point add per entry.
+    The previous 16-step 1M-lane ladder build stalled the round-4 TPU
+    bench inside a single giant remote compile; this build is one small
+    host table + one batched add (+ the shared batched inversion), so
+    the device graphs stay compile-light.  Identity lanes flow through
+    the complete formulas (identity entries are stored projectively).
+    """
+    f = cs.field
+    half = window // 2
+    if window % 2 or half > 8 or 16 % window:
+        raise ValueError(f"unsupported fixed-base window width {window}")
+    t_half = jnp.asarray(_fixed_table_np(cs, key, half))  # (2·nw, 2**half, C, L)
+    lo = t_half[0::2][:, None, :, :, :]  # (nw, 1,  2**half, C, L)
+    hi = t_half[1::2][:, :, None, :, :]  # (nw, 2**half, 1,  C, L)
+    pts = add(cs, lo, hi)  # (nw, 2**half, 2**half, C, L); d = hi·2**half + lo
+    nw = _n_windows(cs, window)
+    return pts.reshape(nw, 1 << window, cs.ncoords, f.limbs)
 
 
 def fixed_base_mul(cs: CurveSpec, table: jax.Array, k: jax.Array) -> jax.Array:
@@ -706,7 +738,7 @@ def scalar_mul_small(cs: CurveSpec, k: jax.Array, p: jax.Array, nbits: int) -> j
     party indices (<= n, so ~14 bits), not full field elements.  With
     the fused kernels active the whole ladder is ONE Pallas launch.
     """
-    if fused_kernels_active():
+    if fused_multi_active(cs):
         from ..ops import pallas_point
 
         batch = jnp.broadcast_shapes(jnp.shape(k), p.shape[:-2])
@@ -743,7 +775,7 @@ def eval_point_poly(
     """
     cs_rev = jnp.moveaxis(coeffs, -3, 0)[::-1]  # (T, ..., C, L) high first
     batch = jnp.broadcast_shapes(coeffs.shape[:-3], x.shape)
-    if fused_kernels_active():
+    if fused_multi_active(cs):
         from ..ops import pallas_point
 
         def step_fused(acc, c_l):
@@ -869,7 +901,7 @@ def msm(cs: CurveSpec, scalars: jax.Array, points: jax.Array) -> jax.Array:
     tables = _build_table(cs, points)  # (..., m, 16, C, L)
     digits = scalar_windows(cs, scalars)  # (..., m, NW)
     digits_rev = jnp.moveaxis(digits, -1, 0)[::-1]  # (NW, ..., m)
-    fused = fused_kernels_active()
+    fused = fused_multi_active(cs)
 
     def step(acc, dig):
         contribs = _gather_table(tables, dig)  # (..., m, C, L)

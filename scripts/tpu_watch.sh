@@ -1,0 +1,25 @@
+#!/bin/bash
+# Tunnel-recovery watcher: probe the axon TPU every PERIOD seconds and
+# launch the proof queue (scripts/tpu_queue.sh) the moment a probe
+# succeeds.  Exists because the tunnel wedges/recovers on its own
+# schedule (round 4) and chip windows are too precious to miss while
+# working on something else.  Probes use `timeout` (SIGTERM) — never
+# SIGKILL a client blocked in an axon RPC (it wedges the tunnel).
+#
+# Usage: nohup bash scripts/tpu_watch.sh > /tmp/tpu_watch.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+PERIOD=${TPU_WATCH_PERIOD:-600}
+while true; do
+  if timeout --kill-after=30 120 python -c "
+import jax, numpy as np, jax.numpy as jnp
+print(np.asarray(jnp.ones((4,4)) @ jnp.ones((4,4)))[0,0])
+" >/dev/null 2>&1; then
+    echo "[tpu_watch] $(date -u +%H:%M:%S) tunnel ALIVE — launching queue"
+    bash scripts/tpu_queue.sh
+    echo "[tpu_watch] queue finished; watcher exiting"
+    exit 0
+  fi
+  echo "[tpu_watch] $(date -u +%H:%M:%S) tunnel still down; sleeping $PERIOD s"
+  sleep "$PERIOD"
+done

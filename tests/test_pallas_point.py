@@ -159,8 +159,13 @@ def test_kernel_add_matches_xla_tpu(curve):
 
 
 @needs_tpu
-@pytest.mark.parametrize("curve", ["ristretto255", "secp256k1"])
+@pytest.mark.parametrize("curve", ["secp256k1"])
 def test_kernel_window_and_ladder_tpu(curve):
+    # Edwards is deliberately absent: Mosaic never returned from
+    # compiling the multi-op Edwards kernel body on v5e (round 4,
+    # >870 s before the hard kill), so production gates Edwards off the
+    # multi-op fused path (groups.device.fused_multi_active) and running
+    # it here would hang the suite the same way.
     cs = gd.ALL_CURVES[curve]
     host_group = gh.ALL_GROUPS[curve]
     pts = gd.from_host(
