@@ -302,7 +302,7 @@ def run(curve: str, n: int, t: int, rho_bits: int = 128):
     cfg = c.cfg
 
     (a, e, s, r), t_deal = timed(
-        lambda ca, cb: ce.deal(cfg, ca, cb, c.g_table, c.h_table),
+        lambda ca, cb: ce.deal_chunked(cfg, ca, cb, c.g_table, c.h_table),
         c.coeffs_a,
         c.coeffs_b,
     )

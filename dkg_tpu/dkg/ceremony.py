@@ -100,6 +100,62 @@ def deal(
     return a_pub, e_comm, shares, hidings
 
 
+def _deal_chunk_default(cfg: CeremonyConfig) -> int:
+    """Dealer-axis chunk size that keeps deal()'s TPU temps in budget.
+
+    The fixed-base scan carries an (n_chunk, t+1, C, L) accumulator
+    whose minor (C, L) dims are tile-padded to (8, 128) by the TPU
+    layout (AOT compile at n=4096 t=1365: "Unpadded (3.39G) Padded
+    (15.51G)", an HBM OOM on a 16 GB v5e).  Temps scale with the
+    dealer chunk, so bound padded-carry bytes to ~6.25 GiB:
+    chunk = 6.25 GiB / ((t+1) * 8 * 128 * 4 B), floored to a power of
+    two so all full chunks share one compiled program (a ragged last
+    chunk compiles once more; bench/BASELINE n are powers of two).
+    AOT-measured at n=4096 t=1365, chunk=1024: peak 8.18 GB — fits
+    with ~2x headroom under the 10.8 GB verify phase that follows.
+    """
+    per_dealer = (cfg.t + 1) * 8 * 128 * 4
+    chunk = max(1, (25 << 28) // per_dealer)  # 6.25 GiB padded-carry budget
+    return 1 << max(0, chunk.bit_length() - 1)
+
+
+def deal_chunked(
+    cfg: CeremonyConfig,
+    coeffs_a: jax.Array,
+    coeffs_b: jax.Array,
+    g_table: jax.Array,
+    h_table: jax.Array,
+    chunk: int | None = None,
+):
+    """``deal`` in dealer-axis chunks (host loop of identical jit calls).
+
+    Outputs are concatenated on the dealer axis and bit-identical to a
+    one-shot ``deal`` (each dealer's row is independent).  Chunking
+    exists purely to bound the TPU scan-carry padding described in
+    :func:`_deal_chunk_default`; when the caller does not pin a chunk,
+    ``DKG_TPU_DEAL_CHUNK`` forces the size (0 disables chunking) —
+    an explicit ``chunk`` argument always wins.
+    """
+    import os
+
+    if chunk is None:
+        env = os.environ.get("DKG_TPU_DEAL_CHUNK")
+        if env is not None:
+            chunk = int(env)
+        else:
+            chunk = _deal_chunk_default(cfg) if fd._on_tpu() else 0
+    # chunk over the rows actually supplied — callers may deal for a
+    # LOCAL subset of dealers (committee_batch: m <= n rows)
+    n_rows = coeffs_a.shape[0]
+    if not chunk or chunk >= n_rows:
+        return deal(cfg, coeffs_a, coeffs_b, g_table, h_table)
+    outs = [
+        deal(cfg, coeffs_a[c0 : c0 + chunk], coeffs_b[c0 : c0 + chunk], g_table, h_table)
+        for c0 in range(0, n_rows, chunk)
+    ]
+    return tuple(jnp.concatenate(parts, axis=0) for parts in zip(*outs))
+
+
 # ---------------------------------------------------------------------------
 # verification kernels
 # ---------------------------------------------------------------------------
@@ -585,7 +641,7 @@ class BatchedCeremony:
 
         cfg = self.cfg
         with phase_span(trace, "deal"):
-            a, e, s, r = deal(
+            a, e, s, r = deal_chunked(
                 cfg, self.coeffs_a, self.coeffs_b, self.g_table, self.h_table
             )
             _jax.block_until_ready(e)

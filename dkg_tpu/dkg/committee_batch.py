@@ -36,7 +36,7 @@ from .broadcast import (
     MisbehavingPartiesRound1,
     ProofOfMisbehaviour,
 )
-from .ceremony import CeremonyConfig, deal
+from .ceremony import CeremonyConfig, deal_chunked
 from .errors import DkgError, DkgErrorKind
 from .procedure_keys import (
     MemberCommunicationKey,
@@ -83,7 +83,7 @@ def batched_dealing(
     coeffs_b = jnp.asarray(
         fh.encode(fs, [[fs.rand_int(rng) for _ in range(t + 1)] for _ in range(m)])
     )
-    bare_dev, rand_dev, shares_dev, hidings_dev = deal(
+    bare_dev, rand_dev, shares_dev, hidings_dev = deal_chunked(
         cfg, coeffs_a, coeffs_b, g_table, h_table
     )
 

@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""AOT TPU compile lab: validate the single-chip bench programs against
+the REAL TPU compiler without a chip.
+
+Round-4 discovery: ``jax.experimental.topologies.get_topology_desc``
+works locally (libtpu compile-only, no device needed), and the first
+AOT compile of the sharded program caught a layout problem invisible to
+XLA:CPU — TPU tiling T(4,128) pads the minor ``(C, L)`` point dims of
+big resting tensors ~7x (u32[11186176,3,24] -> 21.3 GB).  This lab
+AOT-compiles the SINGLE-CHIP deal/verify programs at bench shapes and
+reports per-buffer HBM so layout regressions are caught before a chip
+window is spent on an OOM.
+
+Usage (CPU env — the axon plugin must NOT load):
+
+    PYTHONPATH=/root/repo JAX_PLATFORMS=cpu python scripts/aot_lab.py [n t curve]
+
+Prints one JSON line per compiled phase with memory analysis.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+# Compile-only: the axon plugin must be absent (see SKILL.md); force it
+# off for child-proofing but do NOT re-exec (caller sets the env).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/dkg_tpu_jax_cache_aot")
+
+from jax.experimental import topologies as jtop
+
+from dkg_tpu.dkg import ceremony as ce
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+T = int(sys.argv[2]) if len(sys.argv) > 2 else 1365
+CURVE = sys.argv[3] if len(sys.argv) > 3 else "secp256k1"
+WINDOW = int(os.environ.get("DKG_TPU_FB_WINDOW", "16"))
+RHO_BITS = 128
+
+# v5e:1x1 is rejected by the default 2x2x1 chips_per_host_bounds, so
+# describe the smallest valid slice (2x2) and compile for ONE of its
+# devices — the executable is single-device either way.
+topo = jtop.get_topology_desc("v5e:2x2", "tpu")
+dev = topo.devices[0]
+from jax.sharding import SingleDeviceSharding
+
+sharding = SingleDeviceSharding(dev)
+
+cfg = ce.CeremonyConfig(CURVE, N, T)
+cs = cfg.cs
+fs, bf = cs.scalar, cs.field
+u32 = jnp.uint32
+nw = fs.limbs * (16 // WINDOW)
+
+
+def sds(shape):
+    return jax.ShapeDtypeStruct(shape, u32, sharding=sharding)
+
+
+def report(name, lowered):
+    try:
+        ex = lowered.compile()
+        ma = ex.memory_analysis()
+        rec = {
+            "phase": name,
+            "n": N,
+            "t": T,
+            "curve": CURVE,
+            "fb_window": WINDOW,
+            "ok": True,
+            "argument_bytes": int(ma.argument_size_in_bytes),
+            "output_bytes": int(ma.output_size_in_bytes),
+            "temp_bytes": int(ma.temp_size_in_bytes),
+            "peak_hbm_bytes": int(
+                ma.argument_size_in_bytes
+                + ma.output_size_in_bytes
+                + ma.temp_size_in_bytes
+            ),
+            "fits_16g": (
+                ma.argument_size_in_bytes
+                + ma.output_size_in_bytes
+                + ma.temp_size_in_bytes
+            )
+            < (16 << 30),
+        }
+    except Exception as exc:  # noqa: BLE001 — record the rejection verbatim
+        rec = {
+            "phase": name,
+            "n": N,
+            "t": T,
+            "curve": CURVE,
+            "fb_window": WINDOW,
+            "ok": False,
+            "error": f"{type(exc).__name__}: {exc}"[:500],
+        }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+table_shape = (nw, 1 << WINDOW, cs.ncoords, bf.limbs)
+args_deal = (
+    sds((N, T + 1, fs.limbs)),
+    sds((N, T + 1, fs.limbs)),
+    sds(table_shape),
+    sds(table_shape),
+)
+report(
+    "deal",
+    jax.jit(lambda ca, cb, gt, ht: ce.deal(cfg, ca, cb, gt, ht)).lower(*args_deal),
+)
+
+# the production path on TPU: deal in dealer chunks sized by
+# _deal_chunk_default (the padded-scan-carry OOM fix)
+chunk = ce._deal_chunk_default(cfg)
+if chunk < N:
+    args_chunk = (
+        sds((chunk, T + 1, fs.limbs)),
+        sds((chunk, T + 1, fs.limbs)),
+        sds(table_shape),
+        sds(table_shape),
+    )
+    report(
+        f"deal_chunk_{chunk}",
+        jax.jit(lambda ca, cb, gt, ht: ce.deal(cfg, ca, cb, gt, ht)).lower(*args_chunk),
+    )
+
+pt = (N, T + 1, cs.ncoords, bf.limbs)
+args_verify = (
+    sds(pt),
+    sds((N, N, fs.limbs)),
+    sds((N, N, fs.limbs)),
+    sds((N, fs.limbs)),
+    sds(table_shape),
+    sds(table_shape),
+)
+report(
+    "verify_batch",
+    jax.jit(
+        lambda e, s, r, rho, gt, ht: ce.verify_batch(
+            cfg, e, s, r, rho, RHO_BITS, gt, ht
+        )
+    ).lower(*args_verify),
+)

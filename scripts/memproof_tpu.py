@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""TPU-backend memory accounting for the never-replicate layout.
+
+VERDICT r3 item 8: `MEMPROOF.json` is XLA:CPU accounting — convert the
+never-replicate claim into a TPU-backend fact by AOT-COMPILING (never
+executing) the sharded pipeline at the full BASELINE config-5 shape
+against a real TPU compiler, and recording ITS memory analysis.
+
+Only one physical chip is reachable (axon tunnel), so the 8-device
+program is compiled against an AOT TPU TOPOLOGY
+(`jax.experimental.topologies.get_topology_desc("", "tpu",
+topology_name="v5e:2x4", ...)`) — device-less compilation, exactly the
+"compile-only" path the verdict asks for.  If the axon PJRT plugin
+cannot provide a topology description, the failure mode is recorded in
+the artifact (the verdict's fallback: "documents precisely why
+compile-only isn't possible").
+
+Run with the AMBIENT env (the axon plugin must load):
+
+    cd /root/repo && timeout 1800 python scripts/memproof_tpu.py
+
+Writes MEMPROOF_TPU.json at the repo root.  Reference workload sized:
+the round-1/2 broadcast + verify of committee.rs:151-186, :292-296 at
+SURVEY §6 scale.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+import traceback
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+OUT = pathlib.Path(__file__).resolve().parent.parent / "MEMPROOF_TPU.json"
+
+
+def write(report: dict) -> None:
+    with open(OUT, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report))
+
+
+def main() -> int:
+    report: dict = {
+        "what": (
+            "TPU-compiler memory accounting of the sharded deal + "
+            "verify/finalise programs at BLS12-381 n=16384 t=5461 over 8 "
+            "devices (AOT topology compile, never executed)"
+        ),
+        "config": {
+            "curve": "bls12_381_g1",
+            "n": 16384,
+            "t": 5461,
+            "ndev": 8,
+            "window": 8,
+            "rho_bits": 128,
+        },
+    }
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", "/tmp/dkg_tpu_jax_cache")
+        from jax.experimental import topologies as jtop
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        try:
+            topo = jtop.get_topology_desc("v5e:2x4", "tpu")
+        except Exception as exc:  # noqa: BLE001 — record, try alternates
+            report["topology_error_v5e:2x4"] = f"{type(exc).__name__}: {exc}"[:400]
+            topo = jtop.get_topology_desc(
+                "2x4", "tpu", chips_per_host_bounds="2x4x1", wrap="false"
+            )
+
+        devs = topo.devices
+        report["topology_devices"] = [str(d) for d in devs][:8]
+
+        import numpy as np
+
+        import jax.numpy as jnp  # noqa: F401
+
+        from dkg_tpu.dkg import ceremony as ce
+        from dkg_tpu.parallel import mesh as pmesh
+
+        cfg = ce.CeremonyConfig("bls12_381_g1", 16384, 5461)
+        cs = cfg.cs
+        fs, bf = cs.scalar, cs.field
+        n, t, window, rho_bits = 16384, 5461, 8, 128
+        mesh = Mesh(np.array(devs).reshape(-1), (pmesh.PARTY_AXIS,))
+        nw = fs.limbs * (16 // window)
+        u32 = jnp.uint32
+
+        def sds(shape, spec):
+            return jax.ShapeDtypeStruct(shape, u32, sharding=NamedSharding(mesh, spec))
+
+        shard, repl = P(pmesh.PARTY_AXIS), P()
+        args_deal = (
+            sds((n, t + 1, fs.limbs), shard),
+            sds((n, t + 1, fs.limbs), shard),
+            sds((nw, 1 << window, cs.ncoords, bf.limbs), repl),
+            sds((nw, 1 << window, cs.ncoords, bf.limbs), repl),
+        )
+        deal_exec = (
+            jax.jit(lambda ca, cb, gt, ht: pmesh.sharded_deal(cfg, mesh, ca, cb, gt, ht))
+            .lower(*args_deal)
+            .compile()
+        )
+        pt = (n, t + 1, cs.ncoords, bf.limbs)
+        args_verify = (
+            sds(pt, shard),
+            sds(pt, shard),
+            sds((n, n, fs.limbs), shard),
+            sds((n, n, fs.limbs), shard),
+            args_deal[2],
+            args_deal[3],
+            sds((n, fs.limbs), repl),
+        )
+        verify_exec = (
+            jax.jit(
+                lambda a, e, s, r, gt, ht, rho: pmesh.sharded_verify_finalise(
+                    cfg, mesh, a, e, s, r, gt, ht, rho, rho_bits
+                )
+            )
+            .lower(*args_verify)
+            .compile()
+        )
+
+        from scripts.memproof import collective_results
+
+        full_e = n * (t + 1) * cs.ncoords * bf.limbs * 4
+        report["full_e_tensor_bytes"] = full_e
+
+        def phase(executable):
+            ma = executable.memory_analysis()
+            colls = collective_results(executable.as_text())
+            rec = {
+                "argument_bytes": int(ma.argument_size_in_bytes),
+                "output_bytes": int(ma.output_size_in_bytes),
+                "temp_bytes": int(ma.temp_size_in_bytes),
+                "collectives": sorted(colls, key=lambda c: -c["bytes"])[:8],
+                "max_collective_bytes": max((c["bytes"] for c in colls), default=0),
+            }
+            for opt in ("generated_code_size_in_bytes", "alias_size_in_bytes"):
+                if hasattr(ma, opt):
+                    rec[opt] = int(getattr(ma, opt))
+            return rec
+
+        report["deal"] = phase(deal_exec)
+        report["verify_finalise"] = phase(verify_exec)
+        worst = max(
+            report["deal"]["max_collective_bytes"],
+            report["verify_finalise"]["max_collective_bytes"],
+        )
+        report["never_replicates_e"] = worst < full_e
+        peak = max(
+            report["deal"]["argument_bytes"]
+            + report["deal"]["output_bytes"]
+            + report["deal"]["temp_bytes"],
+            report["verify_finalise"]["argument_bytes"]
+            + report["verify_finalise"]["output_bytes"]
+            + report["verify_finalise"]["temp_bytes"],
+        )
+        report["hbm_v5e"] = {
+            "budget_bytes": 16 << 30,
+            "peak_bytes_per_device": peak,
+            "peak_fits": peak < (16 << 30),
+            "note": (
+                "TPU-compiler accounting (argument+output+temp per device) "
+                "— unlike the CPU MEMPROOF, temp here reflects the real TPU "
+                "buffer assignment"
+            ),
+        }
+        report["ok"] = True
+        write(report)
+        return 0 if report["never_replicates_e"] else 1
+    except Exception as exc:  # noqa: BLE001 — the artifact must always land
+        report["ok"] = False
+        report["error"] = f"{type(exc).__name__}: {exc}"[:600]
+        report["traceback_tail"] = traceback.format_exc().splitlines()[-6:]
+        report["why_compile_only_may_be_impossible"] = (
+            "AOT TPU topology compilation needs the PJRT plugin to expose "
+            "topology descriptions; the axon tunnel plugin may only expose "
+            "the single live chip.  This artifact records the exact failure."
+        )
+        write(report)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -81,4 +81,9 @@ run_step kem_tpu 1800 python scripts/kem_bench.py --n 256 --out KEM_BENCH_TPU.js
 # 7. BLS12-381 widest-limb smoke at n=1024 (VERDICT item 6).
 run_step bls_1024 3600 python scripts/bls_smoke.py 1024
 
+# 8. TPU-compiler memory accounting via AOT topology (VERDICT item 8).
+#    Compile-only; records its own failure mode if the plugin can't
+#    provide a topology.
+run_step memproof_tpu 1800 python scripts/memproof_tpu.py
+
 echo "[tpu_queue] done; logs in $LOGS/" | tee -a "$LOGS/summary.txt"

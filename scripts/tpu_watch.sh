@@ -11,7 +11,11 @@ set -u
 cd "$(dirname "$0")/.."
 PERIOD=${TPU_WATCH_PERIOD:-600}
 while true; do
-  if timeout --kill-after=30 120 python -c "
+  # SIGTERM is serviceable in the plugin's init retry-sleep (probes die
+  # cleanly with rc=143); the SIGKILL escalation gets the same 15-min
+  # grace as the queue so a probe blocked mid-RPC is never hard-killed
+  # quickly (round 4: an immediate SIGKILL wedged the tunnel).
+  if timeout --kill-after=900 120 python -c "
 import jax, numpy as np, jax.numpy as jnp
 print(np.asarray(jnp.ones((4,4)) @ jnp.ones((4,4)))[0,0])
 " >/dev/null 2>&1; then

@@ -354,3 +354,16 @@ def test_point_rlc_schedules_agree_exactly():
     cs = cfg.cs
     for col_bits, col_straus in zip(gd.to_host(cs, d_bits), gd.to_host(cs, d_straus)):
         assert g.eq(col_bits, col_straus)
+
+
+def test_deal_chunked_bit_identical_to_one_shot():
+    """deal_chunked (the TPU scan-carry-padding OOM fix, AOT-diagnosed
+    at n=4096 t=1365: padded temps 15.5 GB > HBM) concatenates to the
+    EXACT one-shot outputs, including a ragged last chunk."""
+    c = ce.BatchedCeremony("secp256k1", 8, 2, b"chunk", random.Random(11))
+    one = ce.deal(c.cfg, c.coeffs_a, c.coeffs_b, c.g_table, c.h_table)
+    chunked = ce.deal_chunked(
+        c.cfg, c.coeffs_a, c.coeffs_b, c.g_table, c.h_table, chunk=3
+    )
+    for a, b in zip(one, chunked):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
