@@ -119,6 +119,27 @@ def _deal_chunk_default(cfg: CeremonyConfig) -> int:
     return 1 << max(0, chunk.bit_length() - 1)
 
 
+def _deal_env_chunk() -> int | None:
+    """DKG_TPU_DEAL_CHUNK, validated: None when unset, else an int >= 0
+    (0 disables chunking).  Raises on anything else — a typo would
+    silently compile the wrong (possibly OOM) program."""
+    import os
+
+    env = os.environ.get("DKG_TPU_DEAL_CHUNK")
+    if env is None:
+        return None
+    try:
+        v = int(env)
+    except ValueError:
+        v = -1
+    if v < 0:
+        raise ValueError(
+            f"DKG_TPU_DEAL_CHUNK={env!r}: expected a non-negative integer "
+            "(0 disables chunking)"
+        )
+    return v
+
+
 def deal_chunked(
     cfg: CeremonyConfig,
     coeffs_a: jax.Array,
@@ -136,13 +157,9 @@ def deal_chunked(
     ``DKG_TPU_DEAL_CHUNK`` forces the size (0 disables chunking) —
     an explicit ``chunk`` argument always wins.
     """
-    import os
-
     if chunk is None:
-        env = os.environ.get("DKG_TPU_DEAL_CHUNK")
-        if env is not None:
-            chunk = int(env)
-        else:
+        chunk = _deal_env_chunk()
+        if chunk is None:
             chunk = _deal_chunk_default(cfg) if fd._on_tpu() else 0
     # chunk over the rows actually supplied — callers may deal for a
     # LOCAL subset of dealers (committee_batch: m <= n rows)
@@ -154,6 +171,41 @@ def deal_chunked(
         for c0 in range(0, n_rows, chunk)
     ]
     return tuple(jnp.concatenate(parts, axis=0) for parts in zip(*outs))
+
+
+def deal_traced_chunked(
+    cfg: CeremonyConfig,
+    coeffs_a: jax.Array,
+    coeffs_b: jax.Array,
+    g_table: jax.Array,
+    h_table: jax.Array,
+):
+    """In-trace twin of :func:`deal_chunked` for sharded bodies.
+
+    Inside ``shard_map`` a host loop cannot run, and an unrolled chunk
+    loop would let XLA overlap the chunks' temp buffers (they are
+    independent), defeating the memory bound — so chunks go through
+    ``lax.map`` (a scan): strictly sequential, temps reused.  The chunk
+    (``DKG_TPU_DEAL_CHUNK`` if set, else the default budget; 0 disables)
+    is floored to a power-of-two divisor of the local row count so the
+    map shape is always exact — a non-dividing chunk must SHRINK, never
+    fall back to the one-shot body the AOT lab showed is rejected at
+    21.3 GB (BLS n=16384 over 8 devices).
+    """
+    m = int(coeffs_a.shape[0])
+    chunk = _deal_env_chunk()
+    if chunk is None:
+        chunk = _deal_chunk_default(cfg)
+    if not chunk or chunk >= m:
+        return deal(cfg, coeffs_a, coeffs_b, g_table, h_table)
+    if m % chunk:
+        # largest power-of-two divisor of m that is <= chunk
+        chunk = min(1 << (chunk.bit_length() - 1), m & -m)
+    k = m // chunk
+    ca = coeffs_a.reshape((k, chunk) + tuple(coeffs_a.shape[1:]))
+    cb = coeffs_b.reshape((k, chunk) + tuple(coeffs_b.shape[1:]))
+    outs = lax.map(lambda p: deal(cfg, p[0], p[1], g_table, h_table), (ca, cb))
+    return tuple(o.reshape((m,) + tuple(o.shape[2:])) for o in outs)
 
 
 # ---------------------------------------------------------------------------

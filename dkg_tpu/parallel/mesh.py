@@ -114,7 +114,10 @@ def sharded_deal(
         out_specs=(P(PARTY_AXIS), P(PARTY_AXIS), P(PARTY_AXIS), P(PARTY_AXIS)),
     )
     def step(ca, cb, gt, ht):
-        return ce.deal(cfg, ca, cb, gt, ht)
+        # chunked in-trace (lax.map) so the fixed-base scan's padded
+        # carry stays bounded per shard — the AOT TPU compile of the
+        # one-shot body at BLS n=16384/8 devices was rejected at 21.3 GB
+        return ce.deal_traced_chunked(cfg, ca, cb, gt, ht)
 
     return step(coeffs_a, coeffs_b, g_table, h_table)
 
