@@ -216,7 +216,7 @@ def north_star_rung():
     the JSON line's ``north_star`` slot.
     """
     t_ns = 1365
-    for n_ns, timeout_s in ((4096, 540.0), (2048, 360.0), (1024, 300.0)):
+    for n_ns, timeout_s in ((4096, 900.0), (2048, 450.0), (1024, 300.0)):
         res = _child(
             "import bench; bench._north_star_child(%d, %d)" % (n_ns, t_ns),
             timeout_s,

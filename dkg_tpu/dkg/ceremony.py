@@ -198,14 +198,22 @@ def deal_traced_chunked(
         chunk = _deal_chunk_default(cfg)
     if not chunk or chunk >= m:
         return deal(cfg, coeffs_a, coeffs_b, g_table, h_table)
-    if m % chunk:
-        # largest power-of-two divisor of m that is <= chunk
-        chunk = min(1 << (chunk.bit_length() - 1), m & -m)
-    k = m // chunk
-    ca = coeffs_a.reshape((k, chunk) + tuple(coeffs_a.shape[1:]))
-    cb = coeffs_b.reshape((k, chunk) + tuple(coeffs_b.shape[1:]))
+    # k full chunks through the sequential map + one ragged tail as a
+    # separate (smaller, so still in budget) call — NOT a collapse to a
+    # power-of-two divisor, which for odd m would degrade to chunk=1
+    # and a pathologically long scan.
+    k, rem = divmod(m, chunk)
+    head = k * chunk
+    ca = coeffs_a[:head].reshape((k, chunk) + tuple(coeffs_a.shape[1:]))
+    cb = coeffs_b[:head].reshape((k, chunk) + tuple(coeffs_b.shape[1:]))
     outs = lax.map(lambda p: deal(cfg, p[0], p[1], g_table, h_table), (ca, cb))
-    return tuple(o.reshape((m,) + tuple(o.shape[2:])) for o in outs)
+    outs = tuple(o.reshape((head,) + tuple(o.shape[2:])) for o in outs)
+    if rem:
+        tail = deal(cfg, coeffs_a[head:], coeffs_b[head:], g_table, h_table)
+        outs = tuple(
+            jnp.concatenate([o, t], axis=0) for o, t in zip(outs, tail)
+        )
+    return outs
 
 
 # ---------------------------------------------------------------------------

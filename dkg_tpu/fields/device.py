@@ -46,7 +46,22 @@ _backend_cache: str | None = None
 
 
 def _on_tpu() -> bool:
-    """Lazy backend probe (never at import time — see hostmesh ordering)."""
+    """Lazy backend probe (never at import time — see hostmesh ordering).
+
+    DKG_TPU_ASSUME_BACKEND overrides the probe: AOT-topology compiles
+    (scripts/aot_lab.py, scripts/memproof_tpu.py) run in a CPU process
+    but target the TPU compiler, and every backend-sensitive dispatch
+    (fused kernels, MXU matmul, table width, RLC schedule) resolves at
+    TRACE time — without the override they would compile a program the
+    chip never runs.
+    """
+    env = os.environ.get("DKG_TPU_ASSUME_BACKEND")
+    if env:  # empty string == the shell idiom for unset
+        if env not in ("tpu", "cpu"):
+            raise ValueError(
+                f"DKG_TPU_ASSUME_BACKEND={env!r}: expected 'tpu' or 'cpu'"
+            )
+        return env == "tpu"
     global _backend_cache
     if _backend_cache is None:
         try:

@@ -30,6 +30,11 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 # Compile-only: the axon plugin must be absent (see SKILL.md); force it
 # off for child-proofing but do NOT re-exec (caller sets the env).
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Resolve every backend-sensitive dispatch (fused kernels, MXU, table
+# width, RLC schedule) as if on the chip, so the compiled program is
+# the one the chip actually runs.  Override with DKG_TPU_ASSUME_BACKEND=cpu
+# to model the conservative flag set.
+os.environ.setdefault("DKG_TPU_ASSUME_BACKEND", "tpu")
 
 import jax
 import jax.numpy as jnp

@@ -27,6 +27,7 @@ SURVEY §6 scale.
 from __future__ import annotations
 
 import json
+import os
 import pathlib
 import sys
 import traceback
@@ -43,6 +44,10 @@ def write(report: dict) -> None:
 
 
 def main() -> int:
+    # Resolve backend-sensitive dispatch as the chip would (fused
+    # kernels, MXU matmul, table width) — without this the CPU process
+    # compiles a program the chip never runs.
+    os.environ.setdefault("DKG_TPU_ASSUME_BACKEND", "tpu")
     report: dict = {
         "what": (
             "TPU-compiler memory accounting of the sharded deal + "

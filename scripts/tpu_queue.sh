@@ -63,8 +63,9 @@ fi
 run_step table_diag 1200 python scripts/table_diag.py
 
 # 3. The bench ladder + north star (VERDICT items 1 & 3).  bench.py is
-#    self-armoring (per-rung child timeouts, CPU fallback).
-run_step bench 5400 python bench.py
+#    self-armoring (per-rung child timeouts, CPU fallback).  Budget
+#    covers all four ladder rungs + the widened north-star attempts.
+run_step bench 7200 python bench.py
 
 # 4. Per-stage profile with flag attribution (VERDICT item 1).
 run_step profile_256 1800 python scripts/profile_verify.py 256
