@@ -187,10 +187,10 @@ def deal_traced_chunked(
     independent), defeating the memory bound — so chunks go through
     ``lax.map`` (a scan): strictly sequential, temps reused.  The chunk
     (``DKG_TPU_DEAL_CHUNK`` if set, else the default budget; 0 disables)
-    is floored to a power-of-two divisor of the local row count so the
-    map shape is always exact — a non-dividing chunk must SHRINK, never
-    fall back to the one-shot body the AOT lab showed is rejected at
-    21.3 GB (BLS n=16384 over 8 devices).
+    is honored exactly: k full chunks ride the map and a non-dividing
+    remainder becomes ONE smaller tail call (still within budget) —
+    never a fallback to the one-shot body the AOT lab showed rejected
+    at 21.3 GB (BLS n=16384 over 8 devices).
     """
     m = int(coeffs_a.shape[0])
     chunk = _deal_env_chunk()

@@ -23,6 +23,11 @@ from jax import lax
 from ..fields import device as fd
 from ..fields.spec import FieldSpec
 
+# HBM budget for eval_many's MXU Vandermonde (+ digit) temps; the point
+# axis is chunked to stay under it.  Module-level so tests can shrink it
+# to force the chunked path at toy sizes.
+EVAL_VAND_BUDGET_BYTES = 1 << 30
+
 
 def eval_many(fs: FieldSpec, coeffs: jax.Array, xs: jax.Array) -> jax.Array:
     """Evaluate polynomials at many points: Horner over the coeff axis.
@@ -38,9 +43,31 @@ def eval_many(fs: FieldSpec, coeffs: jax.Array, xs: jax.Array) -> jax.Array:
         # Vandermonde form on the MXU: one int8 systolic contraction over
         # the T coefficients instead of T sequential VPU field multiplies.
         # V[i, l] = x_i^l costs T muls over (N, L) — negligible vs the
-        # (D, T) x (T, N) product it feeds.
-        vand = powers(fs, xs, coeffs.shape[-2])  # (N, T, L)
-        return fmm.matmul_mod(fs, coeffs, vand)
+        # (D, T) x (T, N) product it feeds.  The POINT axis is chunked:
+        # the Vandermonde and its digit tensor are O(N * T * L) and the
+        # TPU compiler rejected the full-N build at the BLS n=16384
+        # shape (u32[16384,5462,32] = 10.7 GB + a 14.5 GB padded copy,
+        # MEMPROOF_TPU_deal_error.txt); chunks ride a lax.map so temps
+        # are reused, with a ragged tail as one smaller call.
+        t_coef = coeffs.shape[-2]
+
+        def mxu_eval(xc):
+            return fmm.matmul_mod(fs, coeffs, powers(fs, xc, t_coef))
+
+        n_pts = xs.shape[-2]
+        per_point = t_coef * 3 * fs.limbs * 4  # vand + 2L digit columns
+        chunk = max(1, EVAL_VAND_BUDGET_BYTES // per_point)
+        chunk = 1 << (chunk.bit_length() - 1)
+        if chunk >= n_pts:
+            return mxu_eval(xs)
+        k, rem = divmod(n_pts, chunk)
+        head = k * chunk
+        outs = lax.map(mxu_eval, xs[:head].reshape(k, chunk, fs.limbs))
+        out = jnp.moveaxis(outs, 0, -3)  # (m, k, chunk, L)
+        out = out.reshape(out.shape[:-3] + (head, fs.limbs))
+        if rem:
+            out = jnp.concatenate([out, mxu_eval(xs[head:])], axis=-2)
+        return out
 
     # scan MSB-first over coefficients: acc = acc*x + c_k
     cs_rev = jnp.moveaxis(coeffs, -2, 0)[::-1]  # (T, ..., L)

@@ -34,7 +34,8 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 # width, RLC schedule) as if on the chip, so the compiled program is
 # the one the chip actually runs.  Override with DKG_TPU_ASSUME_BACKEND=cpu
 # to model the conservative flag set.
-os.environ.setdefault("DKG_TPU_ASSUME_BACKEND", "tpu")
+if not os.environ.get("DKG_TPU_ASSUME_BACKEND"):  # unset OR empty
+    os.environ["DKG_TPU_ASSUME_BACKEND"] = "tpu"
 
 import jax
 import jax.numpy as jnp

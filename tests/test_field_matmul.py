@@ -114,3 +114,25 @@ def test_matmul_mod_blocking(monkeypatch):
         for j in range(5):
             want = sum(a_int[i][l] * b_int[j][l] for l in range(3)) % fs.modulus
             assert fh.decode_int(fs, out[i, j]) == want
+
+
+def test_eval_many_point_chunking_bit_identical(monkeypatch):
+    """eval_many's MXU path chunks the POINT axis (lax.map + ragged
+    tail) once the Vandermonde/digit temps exceed the budget — the TPU
+    compiler rejected the full-N build at BLS n=16384 (10.7 GB digit
+    tensor, MEMPROOF_TPU_deal_error.txt).  Chunked == full, bit-exact."""
+    import dkg_tpu.poly.device as pdev
+
+    fs = ALL_FIELDS["secp256k1_scalar"]
+    rng = random.Random(77)
+    m, t_coef, n_pts = 3, 5, 7
+    co = jnp.asarray(
+        fh.encode(fs, [[rng.randrange(fs.modulus) for _ in range(t_coef)] for _ in range(m)])
+    )
+    xs = jnp.asarray(fh.encode(fs, [rng.randrange(fs.modulus) for _ in range(n_pts)]))
+    monkeypatch.setenv("DKG_TPU_MXU", "1")
+    full = np.asarray(pdev.eval_many(fs, co, xs))
+    # chunk=2 -> 3 full chunks through lax.map + a ragged tail of 1
+    monkeypatch.setattr(pdev, "EVAL_VAND_BUDGET_BYTES", t_coef * 3 * fs.limbs * 4 * 2)
+    chunked = np.asarray(pdev.eval_many(fs, co, xs))
+    np.testing.assert_array_equal(full, chunked)
