@@ -193,19 +193,24 @@ def matmul_mod(fs: FieldSpec, a: jax.Array, b: jax.Array) -> jax.Array:
         )
     n = b.shape[0]
     a_dig = _to_digits(jnp.asarray(a, jnp.uint32))
-    b_dig = _to_digits(jnp.asarray(b, jnp.uint32))
-
     per_col = m * (4 * l - 1) * 4 + m * 2 * l * 4  # cols + dot bytes per n
+    # + the block's own digit tensor (k * 2l u32 per column): digits are
+    # materialised PER BLOCK inside the map, never for the full N — the
+    # TPU compiler rejected the full-N digitization at the BLS n=16384
+    # verify shape (u32[2048,16384,32] = 4 GB per operand, x2 operands
+    # plus copies; MEMPROOF_TPU_verify_finalise_error.txt).
+    per_col += k * 2 * l * 4
     nb = max(1, min(n, BLOCK_BYTES // per_col))
 
     def block(b_blk):
-        return _reduce_block(fs, _block_cols(fs, a_dig, b_blk))
+        return _reduce_block(fs, _block_cols(fs, a_dig, _to_digits(b_blk)))
 
+    b = jnp.asarray(b, jnp.uint32)
     if nb >= n:
-        return block(b_dig)
+        return block(b)
     nblocks = -(-n // nb)
     pad = nblocks * nb - n
     if pad:
-        b_dig = jnp.pad(b_dig, [(0, pad), (0, 0), (0, 0)])
-    out = lax.map(block, b_dig.reshape(nblocks, nb, k, 2 * l))
+        b = jnp.pad(b, [(0, pad), (0, 0), (0, 0)])
+    out = lax.map(block, b.reshape(nblocks, nb, k, l))
     return jnp.moveaxis(out, 0, 1).reshape(m, nblocks * nb, l)[:, :n]
