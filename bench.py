@@ -173,6 +173,7 @@ def _north_star_child(n_ns: int, t_ns: int) -> None:
     import time as _time
 
     from dkg_tpu.dkg import ceremony as ce
+    from dkg_tpu.utils.tracing import CeremonyTrace
 
     _configure_cache()
     rng = random.Random(0x4096)
@@ -182,9 +183,13 @@ def _north_star_child(n_ns: int, t_ns: int) -> None:
     sync(out["master"])
     assert bool(jnp.asarray(out["ok"]).all())
     cold = _time.perf_counter() - t0
-    # warm run: compiles amortise over the ceremony in production
+    # warm run: compiles amortise over the ceremony in production; the
+    # trace splits the wall-clock into deal / fiat_shamir / verify /
+    # finalise so the device Merkle transcript digest (the round-4 ask)
+    # is measured at this shape, not just at the ladder's n
+    trace = CeremonyTrace()
     t0 = _time.perf_counter()
-    out = c.run(rho_bits=128)
+    out = c.run(rho_bits=128, trace=trace)
     sync(out["master"])
     warm = _time.perf_counter() - t0
     scale = (4096 / n_ns) ** 2  # pair count dominates
@@ -196,6 +201,9 @@ def _north_star_child(n_ns: int, t_ns: int) -> None:
                 "t": t_ns,
                 "ceremony_s": round(warm, 3),
                 "cold_s": round(cold, 3),
+                "phases_s": {
+                    k: round(v, 3) for k, v in trace.timings_s.items()
+                },
                 "extrapolated_n4096_s": round(warm * scale, 3),
                 "single_chip_budget_s": 80.0,
                 "on_budget": bool(warm * scale < 80.0),
@@ -225,6 +233,28 @@ def north_star_rung():
             return res
         print(f"north-star rung n={n_ns} failed", file=sys.stderr)
     return {"error": "all north-star rungs failed"}
+
+
+def kem_rung():
+    """Hybrid-encryption leg (device KEM + host DEM) at the bench shape,
+    reported INSIDE the bench artifact next to the engine numbers — the
+    engine rungs move plaintext limbs over the mesh, so the wire path's
+    KEM cost must be quantified where the exclusion happens (round-4
+    verdict; reference pays 4n KEM mults per dealer, elgamal.rs:134-145).
+    Reuses scripts/kem_bench.py (which also refreshes KEM_BENCH.json);
+    ladder shape first, a smaller insurance shape second.
+    """
+    for n_kem, timeout_s in ((1024, 900.0), (256, 480.0)):
+        res = _child(
+            "import runpy,sys; sys.argv=['kem_bench.py','--n','%d']; "
+            "runpy.run_path('scripts/kem_bench.py', run_name='__main__')"
+            % n_kem,
+            timeout_s,
+        )
+        if res is not None:
+            return res
+        print(f"kem rung n={n_kem} failed", file=sys.stderr)
+    return {"error": "all kem rungs failed"}
 
 
 def _child(code: str, timeout_s: float) -> dict | None:
@@ -542,6 +572,9 @@ def main():
         north_star = None
         if platform == "tpu" and os.environ.get("DKG_TPU_BENCH_NS") != "0":
             north_star = north_star_rung()
+        kem = None
+        if platform == "tpu" and os.environ.get("DKG_TPU_BENCH_KEM") != "0":
+            kem = kem_rung()
         print(
             json.dumps(
                 {
@@ -561,6 +594,7 @@ def main():
                         "flags": extra_env,  # {} == defaults
                         "tpu_cpu_bit_exact": parity,
                         "north_star": north_star,
+                        "kem": kem,
                     },
                 }
             )

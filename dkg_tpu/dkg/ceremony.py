@@ -119,13 +119,14 @@ def _deal_chunk_default(cfg: CeremonyConfig) -> int:
     return 1 << max(0, chunk.bit_length() - 1)
 
 
-def _deal_env_chunk() -> int | None:
-    """DKG_TPU_DEAL_CHUNK, validated: None when unset, else an int >= 0
+def _env_chunk(name: str) -> int | None:
+    """A validated chunk-size env knob: None when unset, else an int >= 0
     (0 disables chunking).  Raises on anything else — a typo would
-    silently compile the wrong (possibly OOM) program."""
+    silently compile the wrong (possibly OOM) program.  Shared by
+    DKG_TPU_DEAL_CHUNK here and DKG_TPU_VERIFY_CHUNK (parallel/mesh)."""
     import os
 
-    env = os.environ.get("DKG_TPU_DEAL_CHUNK")
+    env = os.environ.get(name)
     if env is None:
         return None
     try:
@@ -134,10 +135,14 @@ def _deal_env_chunk() -> int | None:
         v = -1
     if v < 0:
         raise ValueError(
-            f"DKG_TPU_DEAL_CHUNK={env!r}: expected a non-negative integer "
+            f"{name}={env!r}: expected a non-negative integer "
             "(0 disables chunking)"
         )
     return v
+
+
+def _deal_env_chunk() -> int | None:
+    return _env_chunk("DKG_TPU_DEAL_CHUNK")
 
 
 def deal_chunked(
@@ -707,7 +712,8 @@ class BatchedCeremony:
             _jax.block_until_ready(e)
         if tamper is not None:
             a, e, s, r = tamper(a, e, s, r)
-        rho = jnp.asarray(derive_rho(cfg, a, e, s, r, rho_bits))
+        with phase_span(trace, "fiat_shamir"):
+            rho = jnp.asarray(derive_rho(cfg, a, e, s, r, rho_bits))
         with phase_span(trace, "verify"):
             ok = verify_batch(cfg, e, s, r, rho, rho_bits, self.g_table, self.h_table)
             _jax.block_until_ready(ok)

@@ -64,6 +64,35 @@ from jax import lax
 PARTY_AXIS = "parties"
 
 
+def _verify_env_chunk() -> int | None:
+    """DKG_TPU_VERIFY_CHUNK (0 disables), validated by the shared knob
+    parser in ceremony."""
+    return ce._env_chunk("DKG_TPU_VERIFY_CHUNK")
+
+
+def _verify_chunk_default(cfg: ce.CeremonyConfig, block: int) -> int:
+    """Recipient-axis chunk width for the sharded verify/finalise body.
+
+    The round-2 share delivery moves the (n, block, L) u32 share matrix
+    through an ``all_to_all`` whose send AND recv buffers are live
+    temps, and the same tensor is then copied into ``aggregate_shares``
+    and padded by the MXU matmul digitizer — at BLS n=16384/8 devices
+    each of those is ~2 GB, and the TPU buffer assigner fragmented them
+    into a 48.62 G program (MEMPROOF_TPU round 4, vs 15.75 G HBM).
+    Chunking the recipient axis bounds every one of those temps at once:
+    per chunk the a2a moves (n, w, L), the aggregate carries (w, L),
+    and the digitizer pads (w, n, L)-shaped operands.
+
+    Budget: recv buffer n * w * L * 4 B <= 128 MiB, floored to a power
+    of two so full chunks share one program, clamped to [1, block].
+    """
+    fs = cfg.cs.scalar
+    per_recipient = cfg.n * fs.limbs * 4
+    w = max(1, (128 << 20) // per_recipient)
+    w = 1 << max(0, w.bit_length() - 1)
+    return min(w, block)
+
+
 def make_mesh(n_devices: int | None = None) -> Mesh:
     """1-D mesh over the party axis (v5e-8: 8 shards, 512 parties/shard
     at n=4096 — SURVEY §2 table row 4).
@@ -163,9 +192,6 @@ def sharded_verify_finalise(
         out_specs=(P(PARTY_AXIS), P(PARTY_AXIS), P()),
     )
     def step(a_sh, e_sh, s_sh, r_sh, gt, ht, rho_all):
-        # --- share delivery: dealer-sharded -> recipient-sharded
-        s_recv = lax.all_to_all(s_sh, PARTY_AXIS, split_axis=1, concat_axis=0, tiled=True)
-        r_recv = lax.all_to_all(r_sh, PARTY_AXIS, split_axis=1, concat_axis=0, tiled=True)
         shard = lax.axis_index(PARTY_AXIS)
         block = cfg.n // n_dev
         first = shard * block + 1
@@ -176,21 +202,23 @@ def sharded_verify_finalise(
         d_part = ce._point_rlc(cs, rho_local, e_sh, rho_bits)  # (t+1, C, L)
         d_all = lax.all_gather(d_part, PARTY_AXIS)  # (ndev, t+1, C, L)
         d_comm = gd._tree_reduce(cs, jnp.moveaxis(d_all, 0, -3), n_dev)
-        # --- round 2: RLC batch verification of the local recipient block
-        ok = _verify_block(
-            cfg, d_comm, s_recv, r_recv, rho_all, rho_bits, gt, ht, first, block
-        )
+        # --- round 2 + aggregation, recipient-chunked: share delivery
+        # (all_to_all), RLC batch verification, and the qualified-sum all
+        # ride one bounded-width loop so no (n, block, L) temp ever
+        # materialises (the round-4 MEMPROOF_TPU 48.6 G blow-up)
         qual = jnp.ones((cfg.n,), bool)  # blame re-finalises separately
-        finals, master = _finalise_shardlocal(
-            cfg, n_dev, a_sh, s_recv, qual, shard, block
+        ok, finals = _verify_aggregate_chunked(
+            cfg, n_dev, d_comm, s_sh, r_sh, rho_all, rho_bits, gt, ht,
+            qual, first, block,
         )
+        master = _master_shardlocal(cfg, n_dev, a_sh, qual, shard, block)
         return ok, finals, master
 
     return step(a, e, s, r, g_table, h_table, rho)
 
 
-def _finalise_shardlocal(cfg, n_dev, a_sh, s_recv, qual, shard, block):
-    """Aggregation + master key inside a shard_map body.
+def _master_shardlocal(cfg, n_dev, a_sh, qual, shard, block):
+    """Master key inside a shard_map body.
 
     Masks the shard's bare A_{j,0} by ITS slice of the qualified set
     before reducing — same semantics as the single-device
@@ -198,13 +226,104 @@ def _finalise_shardlocal(cfg, n_dev, a_sh, s_recv, qual, shard, block):
     always cover the same dealer set.
     """
     cs = cfg.cs
-    finals = ce.aggregate_shares(cfg, s_recv, qual)
     q_local = lax.dynamic_slice_in_dim(qual, shard * block, block, 0)
     a0 = gd.select(q_local, a_sh[:, 0], gd.identity(cs, (block,)))
     m_part = gd._tree_reduce(cs, a0, block)  # (C, L)
     m_all = lax.all_gather(m_part, PARTY_AXIS)  # (ndev, C, L)
-    master = gd._tree_reduce(cs, m_all, n_dev)
-    return finals, master
+    return gd._tree_reduce(cs, m_all, n_dev)
+
+
+def _recipient_chunk(cfg, block: int) -> int:
+    """Resolved recipient-chunk width: env override else budget default;
+    0 / >= block means unchunked."""
+    chunk = _verify_env_chunk()
+    if chunk is None:
+        chunk = _verify_chunk_default(cfg, block)
+    return chunk
+
+
+def _chunked_recipient_loop(n_dev, block: int, chunk: int, run, tensors):
+    """Drive ``run(off, w, *slices)`` over recipient-axis chunks.
+
+    ``tensors`` are dealer-sharded (block_d, n, L) arrays whose global
+    recipient axis 1 is viewed as (n_dev, block); each chunk passes the
+    [off, off+w) slice of EVERY destination's local block, reshaped to
+    (block_d, n_dev*w, L) — exactly what a tiled ``all_to_all`` on axis
+    1 expects.  Full chunks go through ``lax.map`` (strictly sequential,
+    temps reused — an unrolled loop would let XLA overlap the chunks'
+    buffers and defeat the memory bound); a non-dividing remainder is
+    ONE smaller tail call, mirroring ce.deal_traced_chunked.  Outputs
+    are concatenated on the leading (recipient) axis.
+    """
+    views = []
+    for x in tensors:
+        bd = x.shape[0]
+        views.append(x.reshape((bd, n_dev, block) + tuple(x.shape[2:])))
+
+    def call(off, w):
+        sl = []
+        for v in views:
+            bd = v.shape[0]
+            c = lax.dynamic_slice_in_dim(v, off, w, axis=2)
+            sl.append(c.reshape((bd, n_dev * w) + tuple(v.shape[3:])))
+        return run(off, w, *sl)
+
+    if not chunk or chunk >= block:
+        return call(0, block)
+    k, rem = divmod(block, chunk)
+    offs = jnp.arange(k, dtype=jnp.int32) * chunk
+    outs = lax.map(lambda off: call(off, chunk), offs)
+    outs = tuple(o.reshape((k * chunk,) + tuple(o.shape[2:])) for o in outs)
+    if rem:
+        tail = call(k * chunk, rem)
+        outs = tuple(jnp.concatenate([o, t], axis=0) for o, t in zip(outs, tail))
+    return outs
+
+
+def _verify_aggregate_chunked(
+    cfg, n_dev, d_comm, s_sh, r_sh, rho, rho_bits, gt, ht, qual, first, block
+):
+    """Share delivery + RLC batch verify + qualified aggregation, in
+    recipient chunks inside a shard_map body.
+
+    One all_to_all per chunk delivers (n, w, L) share/hiding rows; the
+    chunk is verified (same equations as ce.verify_batch, shard-local
+    recipient indices) and aggregated immediately, so peak live temps
+    scale with w, not block.  Bit-identical to the one-shot body: each
+    recipient's check and final share read only that recipient's column.
+    """
+    cs = cfg.cs
+    fs = cs.scalar
+
+    def run(off, w, sc, rc):
+        s_recv = lax.all_to_all(sc, PARTY_AXIS, split_axis=1, concat_axis=0, tiled=True)
+        r_recv = lax.all_to_all(rc, PARTY_AXIS, split_axis=1, concat_axis=0, tiled=True)
+        s_rlc = ce._field_dot(fs, rho, s_recv)  # (w, L)
+        r_rlc = ce._field_dot(fs, rho, r_recv)
+        xs = (first + off + jnp.arange(w, dtype=jnp.uint32)).astype(jnp.uint32)
+        rhs = gd.eval_point_poly(cs, d_comm, xs, cfg.index_bits)
+        lhs = gd.add(
+            cs,
+            gd.fixed_base_mul(cs, gt, s_rlc),
+            gd.fixed_base_mul(cs, ht, r_rlc),
+        )
+        return gd.eq(cs, lhs, rhs), ce.aggregate_shares(cfg, s_recv, qual)
+
+    chunk = _recipient_chunk(cfg, block)
+    return _chunked_recipient_loop(n_dev, block, chunk, run, (s_sh, r_sh))
+
+
+def _aggregate_chunked(cfg, n_dev, s_sh, qual, block):
+    """Chunked share delivery + qualified aggregation only (the blame
+    re-finalise path: verification already adjudicated)."""
+
+    def run(off, w, sc):
+        s_recv = lax.all_to_all(sc, PARTY_AXIS, split_axis=1, concat_axis=0, tiled=True)
+        return (ce.aggregate_shares(cfg, s_recv, qual),)
+
+    chunk = _recipient_chunk(cfg, block)
+    (finals,) = _chunked_recipient_loop(n_dev, block, chunk, run, (s_sh,))
+    return finals
 
 
 def sharded_finalise(
@@ -226,10 +345,11 @@ def sharded_finalise(
         out_specs=(P(PARTY_AXIS), P()),
     )
     def step(a_sh, s_sh, qual):
-        s_recv = lax.all_to_all(s_sh, PARTY_AXIS, split_axis=1, concat_axis=0, tiled=True)
         shard = lax.axis_index(PARTY_AXIS)
         block = cfg.n // n_dev
-        return _finalise_shardlocal(cfg, n_dev, a_sh, s_recv, qual, shard, block)
+        finals = _aggregate_chunked(cfg, n_dev, s_sh, qual, block)
+        master = _master_shardlocal(cfg, n_dev, a_sh, qual, shard, block)
+        return finals, master
 
     return step(a, s, qualified)
 
@@ -347,23 +467,3 @@ def _check_mesh(cfg: ce.CeremonyConfig, mesh: Mesh) -> int:
     if cfg.n % n_dev != 0:
         raise ValueError("committee size must divide evenly over the mesh")
     return n_dev
-
-
-def _verify_block(cfg, d_comm, s_recv, r_recv, rho, rho_bits, g_table, h_table, first, block):
-    """RLC batch verification for a block of recipients [first, first+block).
-
-    Same equations as ce.verify_batch but with shard-local recipient
-    indices; the combined commitment columns ``d_comm`` (t+1, C, L) are
-    supplied by the caller (assembled from per-shard partial RLCs)."""
-    cs = cfg.cs
-    fs = cs.scalar
-    s_rlc = ce._field_dot(fs, rho, s_recv)  # (block, L)
-    r_rlc = ce._field_dot(fs, rho, r_recv)
-    xs = first + jnp.arange(block, dtype=jnp.uint32)
-    rhs = gd.eval_point_poly(cs, d_comm, xs, cfg.index_bits)
-    lhs = gd.add(
-        cs,
-        gd.fixed_base_mul(cs, g_table, s_rlc),
-        gd.fixed_base_mul(cs, h_table, r_rlc),
-    )
-    return gd.eq(cs, lhs, rhs)
