@@ -258,39 +258,73 @@ def kem_rung():
 
 
 def _child(code: str, timeout_s: float) -> dict | None:
-    """Run a bench stage in a killable child; parse its last stdout line.
+    """Run a bench stage in a time-boxed child; parse its last stdout line.
 
     EVERY measuring stage runs this way: a wedged tunnel or stalled
     remote compile costs that stage its timeout, never the artifact
     (the round-2 lesson, generalised after watching a live wedge stall
     an in-process rung indefinitely this round).  The persistent compile
     cache makes the lost warm state cheap to rebuild.
+
+    Timeout discipline: SIGTERM + a grace period, then ABANDON — never
+    SIGKILL.  subprocess.run(timeout=...) SIGKILLs, and SIGKILLing a
+    client blocked mid-axon-RPC has wedged the tunnel for EVERY
+    subsequent client (observed round 4 and again round 5: the first
+    rung's SIGKILL at its 1500 s timeout left every later rung's
+    backend init sleeping in the plugin retry loop).  An abandoned
+    child sleeps at zero CPU and exits when the RPC finally resolves.
+    """
+    rc, out, err = _child_capture(code, timeout_s)
+    if rc is None:
+        print(f"bench child: {err}", file=sys.stderr)
+        return None
+    if rc != 0 or not out.strip():
+        print(f"bench child rc={rc}: {err.strip()[-300:]}", file=sys.stderr)
+        return None
+    try:
+        return json.loads(out.strip().splitlines()[-1])
+    except ValueError:
+        print(f"bench child bad output: {out[-200:]}", file=sys.stderr)
+        return None
+
+
+def _child_capture(code: str, timeout_s: float, cwd: str | None = None):
+    """The ONE tunnel-safe subprocess harness (also used by
+    scripts/ed_bisect.py): Popen a ``python -c`` child, wait up to
+    ``timeout_s``, and on expiry SIGTERM + 60 s grace, then ABANDON.
+
+    Returns (returncode, stdout, stderr); returncode None means the
+    time-box expired (stderr then carries the diagnosis).  An abandoned
+    child sleeps at zero CPU in the plugin retry loop and exits when
+    its RPC finally resolves.
     """
     import pathlib
     import subprocess
 
     try:
-        r = subprocess.run(
+        proc = subprocess.Popen(
             [sys.executable, "-c", code],
-            timeout=timeout_s,
-            capture_output=True,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
             text=True,
-            cwd=str(pathlib.Path(__file__).parent),
+            cwd=cwd or str(pathlib.Path(__file__).parent),
         )
-    except Exception as exc:  # noqa: BLE001 — timeout/spawn failure
-        print(f"bench child timed out/failed: {exc}", file=sys.stderr)
-        return None
-    if r.returncode != 0 or not r.stdout.strip():
-        print(
-            f"bench child rc={r.returncode}: {r.stderr.strip()[-300:]}",
-            file=sys.stderr,
-        )
-        return None
+    except Exception as exc:  # noqa: BLE001 — spawn failure
+        return None, "", f"spawn failed: {exc}"
     try:
-        return json.loads(r.stdout.strip().splitlines()[-1])
-    except ValueError:
-        print(f"bench child bad output: {r.stdout[-200:]}", file=sys.stderr)
-        return None
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.terminate()  # SIGTERM: let the runtime unwind the RPC
+        try:
+            proc.communicate(timeout=60.0)
+        except subprocess.TimeoutExpired:
+            return None, "", (
+                f"exceeded {timeout_s}s and ignored SIGTERM for 60s "
+                "(blocked in an uninterruptible RPC); abandoned WITHOUT "
+                "SIGKILL to protect the tunnel"
+            )
+        return None, "", f"timed out after {timeout_s}s; unwound on SIGTERM"
+    return proc.returncode, out, err
 
 
 def _rung_child(curve: str, n: int, t: int) -> None:
@@ -357,19 +391,12 @@ def _accelerator_usable(timeout_s: float = 300.0) -> bool:
     responsive-but-down plugin raises Unavailable quickly, while a
     WEDGED tunnel hangs ``jax.devices()`` forever (observed live this
     round).  An in-process try/except cannot survive the second mode;
-    a killable child probes both.
+    a time-boxed child probes both.  Same SIGTERM-then-abandon
+    discipline as _child: a SIGKILLed probe mid-RPC wedges the tunnel
+    it was probing.
     """
-    import subprocess
-
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s,
-            capture_output=True,
-        )
-        return r.returncode == 0
-    except Exception:  # noqa: BLE001 — timeout/spawn failure == unusable
-        return False
+    rc, _, _ = _child_capture("import jax; jax.devices()", timeout_s)
+    return rc == 0
 
 
 def _init_platform() -> str | None:

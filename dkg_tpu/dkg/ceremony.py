@@ -121,24 +121,11 @@ def _deal_chunk_default(cfg: CeremonyConfig) -> int:
 
 def _env_chunk(name: str) -> int | None:
     """A validated chunk-size env knob: None when unset, else an int >= 0
-    (0 disables chunking).  Raises on anything else — a typo would
-    silently compile the wrong (possibly OOM) program.  Shared by
-    DKG_TPU_DEAL_CHUNK here and DKG_TPU_VERIFY_CHUNK (parallel/mesh)."""
-    import os
+    (0 disables chunking).  Shared by DKG_TPU_DEAL_CHUNK here and
+    DKG_TPU_VERIFY_CHUNK (parallel/mesh)."""
+    from ..utils import envknobs
 
-    env = os.environ.get(name)
-    if env is None:
-        return None
-    try:
-        v = int(env)
-    except ValueError:
-        v = -1
-    if v < 0:
-        raise ValueError(
-            f"{name}={env!r}: expected a non-negative integer "
-            "(0 disables chunking)"
-        )
-    return v
+    return envknobs.nonneg_int(name, "0 disables chunking")
 
 
 def _deal_env_chunk() -> int | None:

@@ -73,6 +73,47 @@ def fused_multi_active(cs: "CurveSpec") -> bool:
     return fused_kernels_active() and cs.kind != "edwards"
 
 
+def _ed_fused_doubles() -> int:
+    """DKG_TPU_ED_FUSED_DOUBLES: Edwards SPLIT-fused window mode.
+
+    K > 0 composes the window step from fused pt_double launches of at
+    most K doublings each plus one fused pt_add — 2-3 kernel launches
+    instead of the one multi-op body Mosaic hangs on, but still VMEM-
+    resident per launch (vs ~9 HBM-roundtripping XLA ops).  0 (default)
+    keeps the plain XLA composition until scripts/ed_bisect.py proves
+    which fused body sizes actually compile on chip.
+    """
+    from ..utils import envknobs
+
+    v = envknobs.nonneg_int(
+        "DKG_TPU_ED_FUSED_DOUBLES",
+        "0 disables the split-fused Edwards window",
+    )
+    return 0 if v is None else v
+
+
+def fused_ladder_active(cs: "CurveSpec") -> bool:
+    """Whether the fused small-scalar ladder kernel is dispatched.
+
+    Follows :func:`fused_multi_active`, plus an Edwards-only opt-in
+    (DKG_TPU_ED_FUSED_LADDER=1): the ladder's fori_loop body is ~one
+    window step of code regardless of nbits, so it may well compile
+    where the unrolled 4-double window body hangs Mosaic —
+    scripts/ed_bisect.py measures exactly that.
+    """
+    import os
+
+    if fused_multi_active(cs):
+        return True
+    env = os.environ.get("DKG_TPU_ED_FUSED_LADDER")
+    if env not in (None, "0", "1"):
+        raise ValueError(
+            f"DKG_TPU_ED_FUSED_LADDER={env!r}: expected '0' or '1' (a "
+            "typo would silently run the wrong kernel path)"
+        )
+    return env == "1" and cs.kind == "edwards" and fused_kernels_active()
+
+
 def _jit_static0(fn):
     """jit with the CurveSpec (hashable, frozen) as a static argument."""
     return jax.jit(fn, static_argnums=0)
@@ -738,7 +779,7 @@ def scalar_mul_small(cs: CurveSpec, k: jax.Array, p: jax.Array, nbits: int) -> j
     party indices (<= n, so ~14 bits), not full field elements.  With
     the fused kernels active the whole ladder is ONE Pallas launch.
     """
-    if fused_multi_active(cs):
+    if fused_ladder_active(cs):
         from ..ops import pallas_point
 
         batch = jnp.broadcast_shapes(jnp.shape(k), p.shape[:-2])
@@ -775,7 +816,7 @@ def eval_point_poly(
     """
     cs_rev = jnp.moveaxis(coeffs, -3, 0)[::-1]  # (T, ..., C, L) high first
     batch = jnp.broadcast_shapes(coeffs.shape[:-3], x.shape)
-    if fused_multi_active(cs):
+    if fused_ladder_active(cs):
         from ..ops import pallas_point
 
         def step_fused(acc, c_l):
@@ -867,6 +908,16 @@ def window_step(
         from ..ops import pallas_point
 
         return pallas_point.pt_window_step(cs, acc, entry, window)
+    k = _ed_fused_doubles() if cs.kind == "edwards" and fused_kernels_active() else 0
+    if k:
+        from ..ops import pallas_point
+
+        d = window
+        while d > 0:
+            c = min(k, d)
+            acc = pallas_point.pt_double(cs, acc, c)
+            d -= c
+        return pallas_point.pt_add(cs, acc, entry)
     for _ in range(window):
         acc = _double_xla(cs, acc)
     return _add_xla(cs, acc, entry)

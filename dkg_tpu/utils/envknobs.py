@@ -1,0 +1,33 @@
+"""Validated environment-knob parsing shared across modules.
+
+Every DKG_TPU_* knob that silently mis-parsing could turn into a wrong
+(possibly OOM or wrong-kernel) compiled program goes through here, so
+the validate-and-raise behavior cannot drift between copies (knobs:
+DKG_TPU_DEAL_CHUNK / DKG_TPU_VERIFY_CHUNK via dkg.ceremony._env_chunk,
+DKG_TPU_ED_FUSED_DOUBLES via groups.device).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def nonneg_int(name: str, what: str) -> int | None:
+    """None when ``name`` is unset, else its value as an int >= 0.
+
+    Raises ValueError on anything else — a typo must fail loudly, never
+    silently select a default.  ``what`` explains the zero semantics in
+    the error message (e.g. "0 disables chunking").
+    """
+    env = os.environ.get(name)
+    if env is None:
+        return None
+    try:
+        v = int(env)
+    except ValueError:
+        v = -1
+    if v < 0:
+        raise ValueError(
+            f"{name}={env!r}: expected a non-negative integer ({what})"
+        )
+    return v

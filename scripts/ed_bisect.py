@@ -40,10 +40,12 @@ from __future__ import annotations
 import json
 import os
 import pathlib
-import subprocess
 import sys
 
 _REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(_REPO))
+
+import bench  # noqa: E402 — shared tunnel-safe child harness
 
 CHILD_TMPL = r"""
 import json, random, sys, time
@@ -166,22 +168,18 @@ print(json.dumps({"ed_window_steps_per_s": round(ed, 1),
 
 
 def run_child(code: str, timeout_s: float) -> dict:
+    """Time-boxed case runner on bench.py's shared tunnel-safe harness
+    (SIGTERM + grace, then ABANDON — never SIGKILL: killing a client
+    mid-axon-RPC wedges the tunnel for every subsequent client)."""
+    rc, out, err = bench._child_capture(code, timeout_s, cwd=str(_REPO))
+    if rc is None:
+        return {"ok": False, "error": f"time-box (Mosaic hang?): {err}"}
+    if rc != 0:
+        return {"ok": False, "error": err.strip()[-300:]}
     try:
-        r = subprocess.run(
-            [sys.executable, "-c", code],
-            timeout=timeout_s,
-            capture_output=True,
-            text=True,
-            cwd=str(_REPO),
-        )
-    except subprocess.TimeoutExpired:
-        return {"ok": False, "error": f"timeout {timeout_s}s (Mosaic hang)"}
-    if r.returncode != 0:
-        return {"ok": False, "error": r.stderr.strip()[-300:]}
-    try:
-        return json.loads(r.stdout.strip().splitlines()[-1])
+        return json.loads(out.strip().splitlines()[-1])
     except (ValueError, IndexError):
-        return {"ok": False, "error": f"bad output: {r.stdout[-200:]}"}
+        return {"ok": False, "error": f"bad output: {out[-200:]}"}
 
 
 def main() -> int:

@@ -1,23 +1,22 @@
 #!/bin/bash
 # On-chip proof queue — run the moment the TPU tunnel is alive.
 #
-# Captures the round-4 evidence in priority order (VERDICT r3 "Next
-# round"), cheapest-first so a short tunnel window still yields the
-# highest-value artifacts.  Each step has its own hard timeout (SIGTERM
-# — NEVER SIGKILL: round 4 showed force-killing a client blocked in an
-# axon RPC wedges the tunnel for every later client) and its own log
-# under TPU_QUEUE_LOGS/; a step failing does NOT stop the queue.
-# Inherits the ambient env UNCHANGED: the ambient PYTHONPATH
-# (/root/.axon_site) is how the accelerator plugin's sitecustomize
-# loads — unsetting OR overriding it disables the plugin and the probe
-# would test the wrong thing.
-#
-# Round-4 revisions: the ristretto mosaic check is dropped (multi-op
-# Edwards body provably hangs Mosaic — MOSAIC.json — and production now
-# gates it off via fused_multi_active); table_diag runs early to prove
-# the new composed window-16 build; the bench ladder gained a
-# host-table+fast-paths rung; profile attribution runs come after the
-# headline bench.
+# Round-5 ordering (VERDICT r4 "Next round" item 1): the FIRST action
+# on a live tunnel is the bench ladder — not diagnostics.  Round 4
+# spent its only chip window on kernel microchecks and died before
+# bench.py ran; the ladder is self-armoring (per-rung child timeouts
+# with SIGTERM-then-abandon, pre-armed conservative fallback rungs), so
+# nothing needs to "clear the way" for it.  Everything else is ranked
+# by verdict priority so a short window still yields the highest-value
+# artifacts.  Each step has its own hard timeout (SIGTERM — NEVER a
+# quick SIGKILL: rounds 4 AND 5 showed force-killing a client blocked
+# in an axon RPC wedges the tunnel for every later client; round 5's
+# wedge came from a bench child's own SIGKILL-on-timeout, since fixed)
+# and its own log under TPU_QUEUE_LOGS/; a step failing does NOT stop
+# the queue.  Inherits the ambient env UNCHANGED: the ambient
+# PYTHONPATH (/root/.axon_site) is how the accelerator plugin's
+# sitecustomize loads — unsetting OR overriding it disables the plugin
+# and the probe would test the wrong thing.
 #
 # Usage:  cd /root/repo && bash scripts/tpu_queue.sh
 set -u
@@ -30,11 +29,11 @@ run_step() { # name timeout_s command...
   local name=$1 budget=$2; shift 2
   local t0=$SECONDS
   # SIGTERM at budget; SIGKILL only after a further 15-min grace — a
-  # client blocked in an axon RPC cannot service SIGTERM, and round 4
-  # showed an immediate SIGKILL wedges the tunnel for every later
-  # client.  The long grace gives the RPC a chance to complete/abort so
-  # the process can unwind; the eventual SIGKILL is the lesser evil vs
-  # a queue that never reaches its remaining steps.
+  # client blocked in an axon RPC cannot service SIGTERM, and an
+  # immediate SIGKILL wedges the tunnel for every later client.  The
+  # long grace gives the RPC a chance to complete/abort so the process
+  # can unwind; the eventual SIGKILL is the lesser evil vs a queue that
+  # never reaches its remaining steps.
   timeout --kill-after=900 "$budget" "$@" > "$LOGS/$name.log" 2>&1
   local rc=$?
   summary "$name" "$rc" "$((SECONDS - t0))"
@@ -48,43 +47,31 @@ print(jax.devices())
 print(np.asarray(jnp.ones((8,8)) @ jnp.ones((8,8)))[0,0])
 " || { echo '[tpu_queue] chip not alive; aborting' | tee -a "$LOGS/summary.txt"; exit 2; }
 
-# 1. Mosaic lowering check, tiny shapes (secp only; Edwards multi-op is
-#    a known Mosaic hang, see MOSAIC.json).  If it fails, run the rest
-#    of the queue with the Pallas path off so every step still lands
-#    with a measured (degraded) configuration.
-run_step mosaic_check_secp 900 python scripts/mosaic_check.py secp256k1
-if [ $? -ne 0 ]; then
-  echo '[tpu_queue] mosaic check failed: forcing DKG_TPU_PALLAS=0 for the rest' \
-    | tee -a "$LOGS/summary.txt"
-  export DKG_TPU_PALLAS=0
-fi
+# 1. THE BENCH LADDER, FIRST (VERDICT r4 item 1).  bench.py is
+#    self-armoring: per-rung child timeouts, host-table and
+#    conservative fallback rungs, north-star + KEM rungs folded in,
+#    CPU fallback.  Budget covers the full ladder.
+run_step bench 10800 python bench.py
 
-# 2. Component timings incl. the NEW composed window-16 table build.
-run_step table_diag 1200 python scripts/table_diag.py
-
-# 3. The bench ladder + north star (VERDICT items 1 & 3).  bench.py is
-#    self-armoring (per-rung child timeouts, CPU fallback).  Budget
-#    covers all four ladder rungs + the widened north-star attempts.
-run_step bench 7200 python bench.py
-
-# 4. Per-stage profile with flag attribution (VERDICT item 1).
-run_step profile_256 1800 python scripts/profile_verify.py 256
-run_step profile_256_nopallas 1800 env DKG_TPU_PALLAS=0 python scripts/profile_verify.py 256
-run_step profile_256_nomxu 1800 env DKG_TPU_MXU=0 python scripts/profile_verify.py 256
-run_step profile_256_round1cfg 1800 env DKG_TPU_PALLAS=0 DKG_TPU_MXU=0 DKG_TPU_FB_WINDOW=8 DKG_TPU_RLC=bits python scripts/profile_verify.py 256
-
-# 5. Storm adjudication on chip (VERDICT item 5).
+# 2. Storm adjudication court on chip (VERDICT r4 item 8).
 run_step storm_tpu 2400 python scripts/storm_bench.py --n 256 --t 85 --out STORM_TPU.json
 
-# 6. KEM/DEM wire leg on chip (VERDICT item 4).
-run_step kem_tpu 1800 python scripts/kem_bench.py --n 256 --out KEM_BENCH_TPU.json
+# 3. Edwards Mosaic bisect (VERDICT r4 item 4): which fused Edwards
+#    bodies compile, and what the XLA-composed gate costs.  Child-per-
+#    candidate with SIGTERM-then-abandon timeouts.
+run_step ed_bisect 5400 python scripts/ed_bisect.py
 
-# 7. BLS12-381 widest-limb smoke at n=1024 (VERDICT item 6).
+# 4. Per-stage profile with flag attribution.
+run_step profile_256 1800 python scripts/profile_verify.py 256
+run_step profile_256_round1cfg 1800 env DKG_TPU_PALLAS=0 DKG_TPU_MXU=0 DKG_TPU_FB_WINDOW=8 DKG_TPU_RLC=bits python scripts/profile_verify.py 256
+
+# 5. BLS12-381 widest-limb smoke at n=1024.
 run_step bls_1024 3600 python scripts/bls_smoke.py 1024
 
-# 8. TPU-compiler memory accounting via AOT topology (VERDICT item 8).
+# 6. TPU-compiler memory accounting via AOT topology — re-proof of the
+#    round-5 chunked sharded verify/finalise (VERDICT r4 item 3).
 #    Compile-only; records its own failure mode if the plugin can't
 #    provide a topology.
-run_step memproof_tpu 1800 python scripts/memproof_tpu.py
+run_step memproof_tpu 3600 python scripts/memproof_tpu.py
 
 echo "[tpu_queue] done; logs in $LOGS/" | tee -a "$LOGS/summary.txt"

@@ -228,3 +228,60 @@ def test_affine_canon_is_representation_independent(cs):
     assert (np.asarray(a) == np.asarray(b)).all()
     for orig, canon in zip(pts, gd.to_host(cs, np.asarray(a))):
         assert g.eq(orig, canon)
+
+
+def test_ed_split_fused_window_dispatch(monkeypatch):
+    """DKG_TPU_ED_FUSED_DOUBLES=k routes the (non-multi-fused) Edwards
+    window step through fused pt_double launches of <= k doublings plus
+    one fused pt_add — the Mosaic-hang workaround staged for
+    scripts/ed_bisect.py evidence — and the result stays bit-identical
+    to the XLA composition.  The Pallas entry points are stubbed with
+    their XLA twins so the dispatch logic is tested without compiling
+    interpret-mode kernels (pathological on CPU)."""
+    from dkg_tpu.ops import pallas_point as pp
+
+    cs = gd.RISTRETTO255
+    g = gh.ALL_GROUPS[cs.name]
+    pts = gd.from_host(
+        cs, [g.scalar_mul(g.random_scalar(RNG), g.generator()) for _ in range(4)]
+    )
+    ent = gd.from_host(
+        cs, [g.scalar_mul(g.random_scalar(RNG), g.generator()) for _ in range(4)]
+    )
+    calls = []
+
+    def fake_double(c, p, n_doubles=1, **kw):
+        calls.append(("dbl", n_doubles))
+        for _ in range(n_doubles):
+            p = gd._double_xla(c, p)
+        return p
+
+    def fake_add(c, p, q, **kw):
+        calls.append(("add",))
+        return gd._add_xla(c, p, q)
+
+    monkeypatch.setattr(pp, "pt_double", fake_double)
+    monkeypatch.setattr(pp, "pt_add", fake_add)
+    monkeypatch.setenv("DKG_TPU_PALLAS", "1")
+    monkeypatch.setenv("DKG_TPU_ED_FUSED_DOUBLES", "3")
+    got = gd.window_step(cs, pts, ent, 4, False)
+    assert calls == [("dbl", 3), ("dbl", 1), ("add",)]
+    want = pts
+    for _ in range(4):
+        want = gd._double_xla(cs, want)
+    want = gd._add_xla(cs, want, ent)
+    assert (np.asarray(got) == np.asarray(want)).all()
+
+    # knob validation: garbage must raise, never silently dispatch
+    monkeypatch.setenv("DKG_TPU_ED_FUSED_DOUBLES", "fast")
+    with pytest.raises(ValueError, match="DKG_TPU_ED_FUSED_DOUBLES"):
+        gd.window_step(cs, pts, ent, 4, False)
+
+    # the Edwards ladder opt-in flips fused_ladder_active without
+    # touching the (still-gated) multi-op window
+    monkeypatch.setenv("DKG_TPU_ED_FUSED_LADDER", "1")
+    assert gd.fused_ladder_active(cs)
+    assert not gd.fused_multi_active(cs)
+    monkeypatch.setenv("DKG_TPU_ED_FUSED_LADDER", "maybe")
+    with pytest.raises(ValueError, match="DKG_TPU_ED_FUSED_LADDER"):
+        gd.fused_ladder_active(cs)
