@@ -318,6 +318,16 @@ def _child_capture(code: str, timeout_s: float, cwd: str | None = None):
         try:
             proc.communicate(timeout=60.0)
         except subprocess.TimeoutExpired:
+            # Close our pipe ends before abandoning: when the wedged RPC
+            # finally resolves, the child's unwind traceback can run to
+            # hundreds of KB — past the 64 KiB pipe buffer it would
+            # block in write() forever with the pipes open.  Closed
+            # pipes turn those writes into EPIPE and the child exits.
+            for stream in (proc.stdout, proc.stderr):
+                try:
+                    stream.close()
+                except Exception:  # noqa: BLE001 — already closed/broken
+                    pass
             return None, "", (
                 f"exceeded {timeout_s}s and ignored SIGTERM for 60s "
                 "(blocked in an uninterruptible RPC); abandoned WITHOUT "
@@ -546,13 +556,17 @@ def main():
         "DKG_TPU_RLC": "bits",
     }
     if platform == "tpu":
-        # Middle rung: host-built 8-bit tables with every OTHER fast
-        # path on — isolates the device table build (the round-4 stall)
-        # from the fused-kernel/MXU wins, so a table-build failure
-        # still yields a fast-path measurement.
+        # FIRST rung: host-built 8-bit tables with every OTHER fast path
+        # on (fused Pallas kernels, MXU matmul, Straus RLC).  The
+        # 16-bit DEVICE table build is the one component that has now
+        # stalled on chip in two separate rounds (round-4 MOSAIC 1800 s
+        # table-build stalls; round-5 rung 1 froze 1500 s in the same
+        # place), so the highest-value measurable configuration leads
+        # and the full-default config gets its attempt SECOND — a stall
+        # there no longer costs the round its headline number.
         ladder = [
-            ("secp256k1", 1024, 341, {}, 1500.0),
-            ("secp256k1", 1024, 341, {"DKG_TPU_FB_WINDOW": "8"}, 1200.0),
+            ("secp256k1", 1024, 341, {"DKG_TPU_FB_WINDOW": "8"}, 1500.0),
+            ("secp256k1", 1024, 341, {}, 1200.0),
             ("secp256k1", 1024, 341, conservative, 900.0),
             ("secp256k1", 256, 85, conservative, 600.0),
         ]
@@ -596,12 +610,24 @@ def main():
                 else:
                     os.environ[k] = saved[k]
         parity = bool(parity_res["parity"]) if parity_res else False
-        north_star = None
-        if platform == "tpu" and os.environ.get("DKG_TPU_BENCH_NS") != "0":
-            north_star = north_star_rung()
-        kem = None
-        if platform == "tpu" and os.environ.get("DKG_TPU_BENCH_KEM") != "0":
-            kem = kem_rung()
+        # north-star + KEM children inherit the WINNING rung's flags,
+        # exactly like the parity child: under pure defaults they would
+        # re-enter the 16-bit device table build that has stalled on
+        # chip twice (see the ladder comment) and burn every retry size.
+        os.environ.update(extra_env)
+        try:
+            north_star = None
+            if platform == "tpu" and os.environ.get("DKG_TPU_BENCH_NS") != "0":
+                north_star = north_star_rung()
+            kem = None
+            if platform == "tpu" and os.environ.get("DKG_TPU_BENCH_KEM") != "0":
+                kem = kem_rung()
+        finally:
+            for k in extra_env:
+                if saved.get(k) is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = saved[k]
         print(
             json.dumps(
                 {

@@ -277,18 +277,33 @@ def _point_rlc(cs, weights: jax.Array, points: jax.Array, nbits: int) -> jax.Arr
             # Chunk the first trailing batch axis so the per-point
             # Straus tables stay under ~256 MB regardless of (m, t);
             # any FURTHER batch axes multiply the per-chunk size too.
+            # The chunks MUST run through a sequential lax.map: the
+            # round-4 unrolled concatenate loop let the TPU buffer
+            # assigner overlap ~196 live 252 MB chunk tables at BLS
+            # n=16384 (MEMPROOF_TPU: 26.5 G fragmentation on 6 G of
+            # real temps).  DKG_TPU_RLC_CHUNK overrides the budget
+            # (tests force tiny chunks; 0 disables chunking).
             per_col = m * 16 * cs.ncoords * cs.field.limbs * 4
             for extra in points.shape[2:-2]:
                 per_col *= extra
-            chunk = max(1, (256 << 20) // per_col)
-            if points.shape[1] > chunk:
-                return jnp.concatenate(
-                    [
-                        _point_rlc(cs, weights, points[:, c0 : c0 + chunk], nbits)
-                        for c0 in range(0, points.shape[1], chunk)
-                    ],
-                    axis=0,
-                )
+            chunk = _env_chunk("DKG_TPU_RLC_CHUNK")
+            if chunk is None:
+                chunk = max(1, (256 << 20) // per_col)
+            ncols = points.shape[1]
+            if chunk and ncols > chunk:
+                k, rem = divmod(ncols, chunk)
+                offs = jnp.arange(k, dtype=jnp.int32) * chunk
+
+                def col_chunk(off):
+                    cols = lax.dynamic_slice_in_dim(points, off, chunk, axis=1)
+                    return _point_rlc(cs, weights, cols, nbits)
+
+                out = lax.map(col_chunk, offs)  # (k, chunk, ..., C, L)
+                out = out.reshape((k * chunk,) + tuple(out.shape[2:]))
+                if rem:
+                    tail = _point_rlc(cs, weights, points[:, k * chunk :], nbits)
+                    out = jnp.concatenate([out, tail], axis=0)
+                return out
 
         window = gd.WINDOW
         nd = -(-nbits // window)  # windows that can be non-zero

@@ -3,8 +3,8 @@
 Every DKG_TPU_* knob that silently mis-parsing could turn into a wrong
 (possibly OOM or wrong-kernel) compiled program goes through here, so
 the validate-and-raise behavior cannot drift between copies (knobs:
-DKG_TPU_DEAL_CHUNK / DKG_TPU_VERIFY_CHUNK via dkg.ceremony._env_chunk,
-DKG_TPU_ED_FUSED_DOUBLES via groups.device).
+DKG_TPU_DEAL_CHUNK / DKG_TPU_VERIFY_CHUNK / DKG_TPU_RLC_CHUNK via
+dkg.ceremony._env_chunk, DKG_TPU_ED_FUSED_DOUBLES via groups.device).
 """
 
 from __future__ import annotations

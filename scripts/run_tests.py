@@ -31,8 +31,12 @@ NO_TESTS_COLLECTED = 5
 # XLA:CPU flake's crash probability becomes near-certain late in the
 # file (round 4: test_ceremony.py died at the same late test twice,
 # then every piece passed in isolation).  Shard them into N consecutive
-# pytest processes over the collected test ids.
-SHARDS: dict[str, int] = {"test_ceremony.py": 4}
+# pytest processes over the collected test ids.  Round 5 moved the
+# compile-heavy breadth tests to the slow tier, so the DEFAULT tier no
+# longer needs sharding (each shard re-ran the module fixture's full
+# engine compile — 3x the fixture cost); the slow tier keeps it.
+SHARDS: dict[str, int] = {}
+SLOW_SHARDS: dict[str, int] = {"test_ceremony.py": 4}
 
 
 def _env() -> dict:
@@ -85,7 +89,12 @@ def main() -> int:
     for path in files:
         name = os.path.basename(path)
         t1 = time.time()
-        nshards = SHARDS.get(name, 1)
+        # Crash-isolation shards apply whenever the slow tests are
+        # INCLUDED in the run (explicit -m slow, or a bare invocation
+        # with no filter at all — the heaviest load of the three);
+        # only the default "not slow" tier is light enough to skip them.
+        includes_slow = not any("not slow" in a for a in extra)
+        nshards = (SLOW_SHARDS if includes_slow else SHARDS).get(name, 1)
         chunks: list[list[str] | None] = [None]
         if nshards > 1:
             ids = collect_ids(path, extra)

@@ -105,6 +105,7 @@ def test_batch_verify_all_pass_and_detects_cheat(ceremony):
     assert ok[1:].all()
 
 
+@pytest.mark.slow
 def test_fiat_shamir_binds_entire_transcript(ceremony):
     """rho must change whenever the LOGICAL round-1 transcript changes —
     any dealer's any commitment POINT (the digest hashes canonical
@@ -194,6 +195,7 @@ def test_master_respects_qualified_mask(ceremony):
     )
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("curve", ["secp256k1", "bls12_381_g1"])
 def test_engine_other_curves_smoke(curve):
     """Full engine round on the Weierstrass backends: same oracle as the
@@ -210,6 +212,7 @@ def test_engine_other_curves_smoke(curve):
     assert g.eq(master, g.scalar_mul(secret, g.generator()))
 
 
+@pytest.mark.slow
 def test_batch_verify_non_byte_aligned_rho_bits(ceremony):
     """rho_bits that are not a multiple of 8 (or 4) must still verify an
     honest transcript: fiat_shamir_rho masks to exactly rho_bits so the
@@ -231,6 +234,7 @@ def test_batch_verify_non_byte_aligned_rho_bits(ceremony):
         assert np.asarray(ok).all(), rho_bits
 
 
+@pytest.mark.slow
 def test_run_blame_path_disqualifies_cheating_dealer():
     """An injected cheat makes run() drop from the batch check to
     pairwise blame, record complaints, disqualify the dealer, and finish
@@ -273,6 +277,7 @@ def test_run_blame_path_disqualifies_cheating_dealer():
     assert g.eq(gd.to_host(cs, np.asarray(out["master"])[None])[0], acc)
 
 
+@pytest.mark.slow
 def test_run_aborts_when_cheaters_exceed_threshold():
     c = ce.BatchedCeremony("ristretto255", 8, 2, b"abort", random.Random(6))
     fs = c.cfg.cs.scalar
@@ -290,6 +295,7 @@ def test_run_aborts_when_cheaters_exceed_threshold():
     assert np.asarray(out["qualified"]).sum() == 5
 
 
+@pytest.mark.slow
 def test_run_blame_identifies_random_tamper_patterns():
     """Property-style: for several random tamper patterns, the blame
     path disqualifies EXACTLY the tampered dealers and records exactly
@@ -322,6 +328,7 @@ def test_run_blame_identifies_random_tamper_patterns():
         assert np.asarray(out["qualified"]).tolist() == expect_qualified, trial
 
 
+@pytest.mark.slow
 def test_point_rlc_schedules_agree_exactly():
     """The Straus windowed schedule (XLA window step — the conservative
     TPU configuration) and the bit-at-a-time ladder must produce the
@@ -356,6 +363,7 @@ def test_point_rlc_schedules_agree_exactly():
         assert g.eq(col_bits, col_straus)
 
 
+@pytest.mark.slow
 def test_deal_chunked_bit_identical_to_one_shot():
     """deal_chunked (the TPU scan-carry-padding OOM fix, AOT-diagnosed
     at n=4096 t=1365: padded temps 15.5 GB > HBM) concatenates to the
@@ -367,3 +375,32 @@ def test_deal_chunked_bit_identical_to_one_shot():
     )
     for a, b in zip(one, chunked):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
+def test_point_rlc_column_chunking_bit_identical(monkeypatch):
+    """The sequential-map column chunking of the Straus point-RLC
+    (DKG_TPU_RLC_CHUNK; the MEMPROOF_TPU fragmentation fix) is
+    bit-identical to the unchunked schedule, ragged tail included."""
+    monkeypatch.setenv("DKG_TPU_RLC", "straus")
+    cs = gd.ALL_CURVES["secp256k1"]
+    g = gh.ALL_GROUPS["secp256k1"]
+    rng = random.Random(0x51C)
+    m, cols, nbits = 4, 7, 16
+    pts = [
+        [g.scalar_mul(rng.randrange(1, 1000), g.generator()) for _ in range(cols)]
+        for _ in range(m)
+    ]
+    flat = gd.from_host(cs, [p for row in pts for p in row])
+    points = flat.reshape(m, cols, cs.ncoords, cs.field.limbs)
+    weights = jnp.asarray(
+        fh.encode(cs.scalar, [rng.randrange(1 << nbits) for _ in range(m)])
+    )
+    monkeypatch.setenv("DKG_TPU_RLC_CHUNK", "0")
+    ref = np.asarray(ce._point_rlc(cs, weights, points, nbits))
+    monkeypatch.setenv("DKG_TPU_RLC_CHUNK", "3")  # k=2 full chunks + tail 1
+    got = np.asarray(ce._point_rlc(cs, weights, points, nbits))
+    np.testing.assert_array_equal(got, ref)
+    monkeypatch.setenv("DKG_TPU_RLC_CHUNK", "junk")
+    with pytest.raises(ValueError, match="DKG_TPU_RLC_CHUNK"):
+        ce._point_rlc(cs, weights, points, nbits)

@@ -2,6 +2,8 @@
 
 import random
 
+import pytest
+
 from dkg_tpu.dkg.committee import (
     Environment,
     FetchedComplaints2,
@@ -66,6 +68,7 @@ def test_batched_dealing_full_ceremony():
     assert G.eq(masters[0].point, G.scalar_mul(secret, G.generator()))
 
 
+@pytest.mark.slow
 def test_batched_dealing_subset_matches_init_shape():
     n, t = 3, 1
     env = Environment.init(G, t, n, b"committee-batch-2")
@@ -100,6 +103,7 @@ def _cheating_broadcast(env, keys, victim_indices, dealer_broadcast, rng):
     return BroadcastPhase1(dealer_broadcast.committed_coefficients, tuple(enc))
 
 
+@pytest.mark.slow
 def test_batched_share_verification_matches_serial():
     """The batched round-2 produces the same qualified sets, received
     shares, complaint targets/kinds, and verifiable evidence as n serial
@@ -182,6 +186,7 @@ def test_batched_share_verification_matches_serial():
             assert not batch_phases[i]._state.qualified[4]
 
 
+@pytest.mark.slow
 def test_batched_share_verification_completes_ceremony_with_cheat():
     """End-to-end wire flow at committee scale: batched dealing ->
     batched round-2 with a cheating dealer -> serial phases 3-5; the
@@ -257,6 +262,7 @@ def test_batched_share_verification_completes_ceremony_with_cheat():
         assert G.eq(m.point, masters[0].point)
 
 
+@pytest.mark.slow
 def test_batched_share_verification_error_branches():
     """The two serial error paths reproduce exactly in the batched
     round-2: misaddressed data -> FETCHED_INVALID_DATA (with identical

@@ -1,6 +1,8 @@
 """Batched complaint adjudication == serial MisbehavingPartiesRound1.verify."""
 
 import random
+
+import pytest
 from dataclasses import replace
 
 
@@ -45,6 +47,7 @@ def _tamper_share(b, recipient):
     return replace(b, encrypted_shares=tuple(es))
 
 
+@pytest.mark.slow
 def test_batch_matches_serial_verdicts():
     env, keys, pks, phases, broadcasts = _setup()
     # dealer 2 sends party 1 a corrupted share

@@ -60,6 +60,7 @@ def test_bn254_host_group_law(bn254):
     assert g.eq(g.scalar_mul_vartime(k, g.generator()), p)
 
 
+@pytest.mark.slow
 def test_bn254_device_matches_host(bn254):
     g = bn254
     cs = gd.ALL_CURVES["bn254"]
@@ -72,6 +73,7 @@ def test_bn254_device_matches_host(bn254):
         assert g.eq(pt, g.scalar_mul(k, g.generator())), k
 
 
+@pytest.mark.slow
 def test_bn254_full_batched_ceremony(bn254):
     from dkg_tpu.dkg import ceremony as ce
 

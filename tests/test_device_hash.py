@@ -76,6 +76,7 @@ def test_domain_and_length_bind():
     )
 
 
+@pytest.mark.slow
 def test_ceremony_device_digest_binds_every_tensor():
     import jax.numpy as jnp
     import random as _random

@@ -2,6 +2,8 @@
 
 import random
 
+import pytest
+
 
 from dkg_tpu.crypto.dleq import DleqZkp
 from dkg_tpu.crypto import dleq_batch as db
@@ -30,6 +32,7 @@ def test_generate_batch_verifies_on_host():
         assert proof.verify(G, b1, b2, h1, h2)
 
 
+@pytest.mark.slow
 def test_verify_batch_accepts_host_proofs_rejects_tampered():
     stmts = _statements(4)
     proofs = [

@@ -64,6 +64,7 @@ def test_matmul_mod_extreme_values():
     assert fh.decode_int(fs, out[0, 0]) == (k * top * top) % fs.modulus
 
 
+@pytest.mark.slow
 def test_eval_many_mxu_matches_horner(monkeypatch):
     fs = ALL_FIELDS["ed25519_scalar"]
     from dkg_tpu.poly import device as pdev
@@ -87,6 +88,7 @@ def test_eval_many_mxu_matches_horner(monkeypatch):
             assert fh.decode_int(fs, got[d, i]) == want
 
 
+@pytest.mark.slow
 def test_field_dot_mxu_matches_scan(monkeypatch):
     from dkg_tpu.dkg import ceremony as ce
 
@@ -102,6 +104,7 @@ def test_field_dot_mxu_matches_scan(monkeypatch):
     assert np.array_equal(ref, got)
 
 
+@pytest.mark.slow
 def test_matmul_mod_blocking(monkeypatch):
     """Force a tiny block size so the lax.map path (pad + reassemble)
     is exercised."""
@@ -116,6 +119,7 @@ def test_matmul_mod_blocking(monkeypatch):
             assert fh.decode_int(fs, out[i, j]) == want
 
 
+@pytest.mark.slow
 def test_eval_many_point_chunking_bit_identical(monkeypatch):
     """eval_many's MXU path chunks the POINT axis (lax.map + ragged
     tail) once the Vandermonde/digit temps exceed the budget — the TPU

@@ -2,6 +2,8 @@
 
 import random
 
+import pytest
+
 import numpy as np
 
 import jax.numpy as jnp
@@ -58,6 +60,7 @@ def test_kem_seal_open_roundtrip():
     assert s_bad != fh.decode_int(fs, shares[0, 1])
 
 
+@pytest.mark.slow
 def test_broadcasts_from_batch_shape():
     curve = "ristretto255"
     n, t = 4, 1
@@ -90,6 +93,7 @@ def test_broadcasts_from_batch_shape():
     assert s0 == fhh.decode_int(fs, np.asarray(s)[1, 2])
 
 
+@pytest.mark.slow
 def test_batched_sealing_interops_with_committee_decrypt():
     """Device-sealed pairs open through the wire-protocol path
     (procedure_keys.decrypt_shares) — one KEM point, two KDF tags."""

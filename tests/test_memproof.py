@@ -14,6 +14,11 @@ import pathlib
 
 import pytest
 
+# The whole-module AOT compile accounting is a multi-minute XLA:CPU
+# proof; it belongs to the nightly tier (the TPU twin is
+# scripts/memproof_tpu.py).
+pytestmark = pytest.mark.slow
+
 from dkg_tpu.dkg import ceremony as ce
 from dkg_tpu.parallel import mesh as pmesh
 

@@ -16,6 +16,26 @@ from dkg_tpu.parallel import mesh as pm
 RNG = random.Random(0x5A4D)
 
 
+def test_sharded_ceremony_smoke():
+    """Default-tier sharded smoke: the full mesh ceremony (deal ->
+    digest -> rho -> verify/finalise) runs and self-verifies on the
+    8-virtual-device mesh.  The bit-parity cross-check against the
+    single-device engine lives in the slow twin below — it costs a
+    second full engine compile, which is exactly what the default tier
+    budget cannot afford on the 1-core box."""
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
+    n, t = 8, 3
+    c = ce.BatchedCeremony("ristretto255", n, t, b"sharded-test", RNG)
+    mesh = pm.make_mesh(8)
+    ok, finals, master, qualified = pm.sharded_ceremony(
+        c.cfg, mesh, c.coeffs_a, c.coeffs_b, c.g_table, c.h_table, rho_bits=64
+    )
+    assert np.asarray(ok).all()
+    assert np.asarray(qualified).all()
+    assert np.asarray(finals).shape == (n, c.cfg.cs.scalar.limbs)
+
+
+@pytest.mark.slow
 def test_sharded_matches_single_device():
     assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
     n, t = 8, 3
@@ -43,6 +63,7 @@ def test_sharded_matches_single_device():
     np.testing.assert_array_equal(np.asarray(master), np.asarray(master_ref))
 
 
+@pytest.mark.slow
 def test_sharded_deal_matches_single_device_transcript():
     """The sharded round-1 output (all four tensors dealer-sharded — the
     commitments are deliberately never replicated) is bit-identical to
@@ -166,6 +187,7 @@ def test_party_block_derives_from_mesh_positions(monkeypatch):
         multihost.process_party_block(17, mesh)
 
 
+@pytest.mark.slow
 def test_sharded_blame_disqualifies_cheating_dealer():
     """An injected cheat on the mesh drops the ceremony into
     sharded_blame: the guilty dealer is disqualified on every shard and
@@ -210,6 +232,7 @@ def test_sharded_blame_disqualifies_cheating_dealer():
     np.testing.assert_array_equal(np.asarray(master), np.asarray(out_ref["master"]))
 
 
+@pytest.mark.slow
 def test_sharded_ceremony_aborts_past_threshold():
     """More than t cheating dealers raises MISBEHAVIOUR_HIGHER_THRESHOLD
     (committee.rs:340-347) instead of finalising a key backed by fewer

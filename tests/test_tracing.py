@@ -3,6 +3,8 @@
 import json
 import random
 
+import pytest
+
 from dkg_tpu.dkg import ceremony as ce
 from dkg_tpu.utils.tracing import CeremonyTrace, phase_span
 
@@ -23,11 +25,12 @@ def test_trace_records_phases_and_counters():
     json.loads(tr.json())  # serializable
 
 
+@pytest.mark.slow  # a second full engine compile; nightly tier
 def test_ceremony_run_with_trace():
     rng = random.Random(1)
     c = ce.BatchedCeremony("ristretto255", 5, 2, b"traced", rng)
     tr = CeremonyTrace()
     out = c.run(rho_bits=64, trace=tr)
     assert bool(out["ok"].all())
-    assert set(tr.timings_s) == {"deal", "verify", "finalise"}
+    assert set(tr.timings_s) == {"deal", "fiat_shamir", "verify", "finalise"}
     assert tr.meta["n"] == 5 and tr.meta["curve"] == "ristretto255"
