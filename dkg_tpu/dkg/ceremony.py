@@ -100,29 +100,47 @@ def deal(
     return a_pub, e_comm, shares, hidings
 
 
-def _deal_chunk_default(cfg: CeremonyConfig) -> int:
-    """Dealer-axis chunk size that keeps deal()'s TPU temps in budget.
+def _deal_chunk_default(cfg: CeremonyConfig, m: int | None = None) -> int:
+    """Dealer-axis chunk size that keeps deal()'s TPU peak in budget.
 
     The fixed-base scan carries an (n_chunk, t+1, C, L) accumulator
     whose minor (C, L) dims are tile-padded to (8, 128) by the TPU
     layout (AOT compile at n=4096 t=1365: "Unpadded (3.39G) Padded
     (15.51G)", an HBM OOM on a 16 GB v5e).  Temps scale with the
-    dealer chunk, so bound padded-carry bytes to ~6.25 GiB:
-    chunk = 6.25 GiB / ((t+1) * 8 * 128 * 4 B), floored to a power of
-    two so all full chunks share one compiled program (a ragged last
-    chunk compiles once more; bench/BASELINE n are powers of two).
-    AOT-measured at n=4096 t=1365, chunk=1024: peak 8.18 GB — fits
-    with ~2x headroom under the 10.8 GB verify phase that follows.
+    dealer chunk, and at RUNTIME they must coexist with the phase's own
+    inputs (coefficients) and outputs (a, e, s, r for the ``m`` rows
+    being dealt) — at BLS n=16384 over 8 devices those are 12.2 GB by
+    themselves, so a fixed temp budget cannot be right for every shape.
+    The budget is therefore what remains of a 15 GiB usable device
+    after inputs + outputs (floored at 1 GiB so tiny devices still
+    make progress, capped at 6.25 GiB — the AOT-measured sweet spot at
+    the north-star shape: chunk=1024, peak 8.18 GB, ~2x headroom under
+    the verify phase that follows).
+
+    chunk = budget / ((t+1) * 8 * 128 * 4 B) padded-carry bytes per
+    dealer, floored to a power of two so all full chunks share one
+    compiled program (a ragged last chunk compiles once more).
     """
+    if m is None:
+        m = cfg.n
+    cs = cfg.cs
+    pt_bytes = cs.ncoords * cs.field.limbs * 4
+    sc_bytes = cs.scalar.limbs * 4
+    io_bytes = (
+        2 * m * (cfg.t + 1) * sc_bytes  # coeffs_a + coeffs_b in
+        + 2 * m * (cfg.t + 1) * pt_bytes  # a + e out
+        + 2 * m * cfg.n * sc_bytes  # shares + hidings out
+    )
+    budget = min(25 << 28, max(1 << 30, (15 << 30) - io_bytes))
     per_dealer = (cfg.t + 1) * 8 * 128 * 4
-    chunk = max(1, (25 << 28) // per_dealer)  # 6.25 GiB padded-carry budget
+    chunk = max(1, budget // per_dealer)
     return 1 << max(0, chunk.bit_length() - 1)
 
 
 def _env_chunk(name: str) -> int | None:
     """A validated chunk-size env knob: None when unset, else an int >= 0
-    (0 disables chunking).  Shared by DKG_TPU_DEAL_CHUNK here and
-    DKG_TPU_VERIFY_CHUNK (parallel/mesh)."""
+    (0 disables chunking).  Shared by DKG_TPU_DEAL_CHUNK and
+    DKG_TPU_RLC_CHUNK here and DKG_TPU_VERIFY_CHUNK (parallel/mesh)."""
     from ..utils import envknobs
 
     return envknobs.nonneg_int(name, "0 disables chunking")
@@ -152,7 +170,7 @@ def deal_chunked(
     if chunk is None:
         chunk = _deal_env_chunk()
         if chunk is None:
-            chunk = _deal_chunk_default(cfg) if fd._on_tpu() else 0
+            chunk = _deal_chunk_default(cfg, coeffs_a.shape[0]) if fd._on_tpu() else 0
     # chunk over the rows actually supplied — callers may deal for a
     # LOCAL subset of dealers (committee_batch: m <= n rows)
     n_rows = coeffs_a.shape[0]
@@ -184,28 +202,19 @@ def deal_traced_chunked(
     never a fallback to the one-shot body the AOT lab showed rejected
     at 21.3 GB (BLS n=16384 over 8 devices).
     """
+    from ..utils.scanchunk import map_chunked
+
     m = int(coeffs_a.shape[0])
     chunk = _deal_env_chunk()
     if chunk is None:
-        chunk = _deal_chunk_default(cfg)
-    if not chunk or chunk >= m:
-        return deal(cfg, coeffs_a, coeffs_b, g_table, h_table)
-    # k full chunks through the sequential map + one ragged tail as a
-    # separate (smaller, so still in budget) call — NOT a collapse to a
-    # power-of-two divisor, which for odd m would degrade to chunk=1
-    # and a pathologically long scan.
-    k, rem = divmod(m, chunk)
-    head = k * chunk
-    ca = coeffs_a[:head].reshape((k, chunk) + tuple(coeffs_a.shape[1:]))
-    cb = coeffs_b[:head].reshape((k, chunk) + tuple(coeffs_b.shape[1:]))
-    outs = lax.map(lambda p: deal(cfg, p[0], p[1], g_table, h_table), (ca, cb))
-    outs = tuple(o.reshape((head,) + tuple(o.shape[2:])) for o in outs)
-    if rem:
-        tail = deal(cfg, coeffs_a[head:], coeffs_b[head:], g_table, h_table)
-        outs = tuple(
-            jnp.concatenate([o, t], axis=0) for o, t in zip(outs, tail)
-        )
-    return outs
+        chunk = _deal_chunk_default(cfg, m)
+
+    def call(off, w):
+        ca = lax.dynamic_slice_in_dim(coeffs_a, off, w, 0)
+        cb = lax.dynamic_slice_in_dim(coeffs_b, off, w, 0)
+        return deal(cfg, ca, cb, g_table, h_table)
+
+    return map_chunked(m, chunk, call)
 
 
 # ---------------------------------------------------------------------------
@@ -291,19 +300,13 @@ def _point_rlc(cs, weights: jax.Array, points: jax.Array, nbits: int) -> jax.Arr
                 chunk = max(1, (256 << 20) // per_col)
             ncols = points.shape[1]
             if chunk and ncols > chunk:
-                k, rem = divmod(ncols, chunk)
-                offs = jnp.arange(k, dtype=jnp.int32) * chunk
+                from ..utils.scanchunk import map_chunked
 
-                def col_chunk(off):
-                    cols = lax.dynamic_slice_in_dim(points, off, chunk, axis=1)
+                def col_chunk(off, w):
+                    cols = lax.dynamic_slice_in_dim(points, off, w, axis=1)
                     return _point_rlc(cs, weights, cols, nbits)
 
-                out = lax.map(col_chunk, offs)  # (k, chunk, ..., C, L)
-                out = out.reshape((k * chunk,) + tuple(out.shape[2:]))
-                if rem:
-                    tail = _point_rlc(cs, weights, points[:, k * chunk :], nbits)
-                    out = jnp.concatenate([out, tail], axis=0)
-                return out
+                return map_chunked(ncols, chunk, col_chunk)
 
         window = gd.WINDOW
         nd = -(-nbits // window)  # windows that can be non-zero

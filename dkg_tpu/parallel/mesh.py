@@ -154,7 +154,7 @@ def sharded_deal(
 def sharded_verify_finalise(
     cfg: ce.CeremonyConfig,
     mesh: Mesh,
-    a: jax.Array,  # (n, t+1, C, L) dealer-sharded bare commitments
+    a0: jax.Array,  # (n, C, L) dealer-sharded BARE first columns A_{j,0}
     e: jax.Array,  # (n, t+1, C, L) dealer-sharded randomized commitments
     s: jax.Array,  # (n, n, L) dealer-sharded share matrix
     r: jax.Array,
@@ -179,6 +179,13 @@ def sharded_verify_finalise(
     * the master key: local tree-add of the shard's bare A_{j,0} +
       ``all_gather`` of ndev partial points.
 
+    Takes only the BARE FIRST COLUMNS a0 = a[:, 0] (the master key's
+    sole input, committee.rs:791-796) rather than the full (n, t+1)
+    bare tensor: at BLS n=16384 that keeps a 3.22 G argument out of the
+    round-2 program's working set, and lets the engine FREE the full
+    bare tensor right after the transcript digest — the happy path
+    never reads the other columns.
+
     Returns (ok, final_shares, master): ok/final_shares
     recipient-sharded, master replicated.
     """
@@ -191,7 +198,7 @@ def sharded_verify_finalise(
         in_specs=(P(PARTY_AXIS), P(PARTY_AXIS), P(PARTY_AXIS), P(PARTY_AXIS), P(), P(), P()),
         out_specs=(P(PARTY_AXIS), P(PARTY_AXIS), P()),
     )
-    def step(a_sh, e_sh, s_sh, r_sh, gt, ht, rho_all):
+    def step(a0_sh, e_sh, s_sh, r_sh, gt, ht, rho_all):
         shard = lax.axis_index(PARTY_AXIS)
         block = cfg.n // n_dev
         first = shard * block + 1
@@ -211,23 +218,24 @@ def sharded_verify_finalise(
             cfg, n_dev, d_comm, s_sh, r_sh, rho_all, rho_bits, gt, ht,
             qual, first, block,
         )
-        master = _master_shardlocal(cfg, n_dev, a_sh, qual, shard, block)
+        master = _master_shardlocal(cfg, n_dev, a0_sh, qual, shard, block)
         return ok, finals, master
 
-    return step(a, e, s, r, g_table, h_table, rho)
+    return step(a0, e, s, r, g_table, h_table, rho)
 
 
-def _master_shardlocal(cfg, n_dev, a_sh, qual, shard, block):
-    """Master key inside a shard_map body.
+def _master_shardlocal(cfg, n_dev, a0_sh, qual, shard, block):
+    """Master key inside a shard_map body; a0_sh (block, C, L) are the
+    shard's bare A_{j,0} columns.
 
-    Masks the shard's bare A_{j,0} by ITS slice of the qualified set
-    before reducing — same semantics as the single-device
+    Masks them by the shard's slice of the qualified set before
+    reducing — same semantics as the single-device
     master_key_from_bare, so the master key and the aggregated shares
     always cover the same dealer set.
     """
     cs = cfg.cs
     q_local = lax.dynamic_slice_in_dim(qual, shard * block, block, 0)
-    a0 = gd.select(q_local, a_sh[:, 0], gd.identity(cs, (block,)))
+    a0 = gd.select(q_local, a0_sh, gd.identity(cs, (block,)))
     m_part = gd._tree_reduce(cs, a0, block)  # (C, L)
     m_all = lax.all_gather(m_part, PARTY_AXIS)  # (ndev, C, L)
     return gd._tree_reduce(cs, m_all, n_dev)
@@ -249,12 +257,12 @@ def _chunked_recipient_loop(n_dev, block: int, chunk: int, run, tensors):
     recipient axis 1 is viewed as (n_dev, block); each chunk passes the
     [off, off+w) slice of EVERY destination's local block, reshaped to
     (block_d, n_dev*w, L) — exactly what a tiled ``all_to_all`` on axis
-    1 expects.  Full chunks go through ``lax.map`` (strictly sequential,
-    temps reused — an unrolled loop would let XLA overlap the chunks'
-    buffers and defeat the memory bound); a non-dividing remainder is
-    ONE smaller tail call, mirroring ce.deal_traced_chunked.  Outputs
-    are concatenated on the leading (recipient) axis.
+    1 expects.  The sequential-map/ragged-tail skeleton (and its
+    never-unroll invariant) lives in utils.scanchunk.map_chunked;
+    outputs are concatenated on the leading (recipient) axis.
     """
+    from ..utils.scanchunk import map_chunked
+
     views = []
     for x in tensors:
         bd = x.shape[0]
@@ -268,16 +276,7 @@ def _chunked_recipient_loop(n_dev, block: int, chunk: int, run, tensors):
             sl.append(c.reshape((bd, n_dev * w) + tuple(v.shape[3:])))
         return run(off, w, *sl)
 
-    if not chunk or chunk >= block:
-        return call(0, block)
-    k, rem = divmod(block, chunk)
-    offs = jnp.arange(k, dtype=jnp.int32) * chunk
-    outs = lax.map(lambda off: call(off, chunk), offs)
-    outs = tuple(o.reshape((k * chunk,) + tuple(o.shape[2:])) for o in outs)
-    if rem:
-        tail = call(k * chunk, rem)
-        outs = tuple(jnp.concatenate([o, t], axis=0) for o, t in zip(outs, tail))
-    return outs
+    return map_chunked(block, chunk, call)
 
 
 def _verify_aggregate_chunked(
@@ -329,7 +328,7 @@ def _aggregate_chunked(cfg, n_dev, s_sh, qual, block):
 def sharded_finalise(
     cfg: ce.CeremonyConfig,
     mesh: Mesh,
-    a: jax.Array,  # (n, t+1, C, L) dealer-sharded
+    a0: jax.Array,  # (n, C, L) dealer-sharded bare first columns
     s: jax.Array,  # (n, n, L) dealer-sharded
     qualified: jax.Array,  # (n,) replicated dealer mask
 ):
@@ -344,14 +343,14 @@ def sharded_finalise(
         in_specs=(P(PARTY_AXIS), P(PARTY_AXIS), P()),
         out_specs=(P(PARTY_AXIS), P()),
     )
-    def step(a_sh, s_sh, qual):
+    def step(a0_sh, s_sh, qual):
         shard = lax.axis_index(PARTY_AXIS)
         block = cfg.n // n_dev
         finals = _aggregate_chunked(cfg, n_dev, s_sh, qual, block)
-        master = _master_shardlocal(cfg, n_dev, a_sh, qual, shard, block)
+        master = _master_shardlocal(cfg, n_dev, a0_sh, qual, shard, block)
         return finals, master
 
-    return step(a, s, qualified)
+    return step(a0, s, qualified)
 
 
 def sharded_blame(
@@ -431,8 +430,13 @@ def sharded_ceremony(
     # multihost-safe: only 32-byte row digests cross process boundaries
     digest = ce.sharded_transcript_digest(cfg, a, e, s, r)
     rho = jnp.asarray(ce.fiat_shamir_rho(cfg, digest, rho_bits))
+    # After the digest only the BARE FIRST COLUMNS are ever read (the
+    # master key); dropping the full bare tensor here returns its HBM
+    # (3.22 G at BLS n=16384) before the round-2 program runs.
+    a0 = a[:, 0]
+    del a
     ok, finals, master = sharded_verify_finalise(
-        cfg, mesh, a, e, s, r, g_table, h_table, rho, rho_bits
+        cfg, mesh, a0, e, s, r, g_table, h_table, rho, rho_bits
     )
     qualified = jnp.ones((cfg.n,), bool)
     if not bool(_host_global(ok).all()):
@@ -447,7 +451,7 @@ def sharded_ceremony(
                 + ", ".join(str(j + 1) for j in np.nonzero(guilty)[0]),
             )
         qualified = jnp.asarray(~guilty)
-        finals, master = sharded_finalise(cfg, mesh, a, s, qualified)
+        finals, master = sharded_finalise(cfg, mesh, a0, s, qualified)
     return ok, finals, master, qualified
 
 

@@ -1,0 +1,45 @@
+"""Sequential chunked mapping over a traced axis.
+
+THE one implementation of the "k full chunks through lax.map + one
+ragged tail call" pattern used by every memory-bounded loop in the
+package (dealer-axis dealing, recipient-axis share delivery/verify,
+Straus point-RLC columns).  The load-bearing invariant lives here:
+chunks MUST run through a sequential ``lax.map`` — an unrolled Python
+loop lets the TPU buffer assigner overlap the chunks' temp buffers,
+defeating the memory bound entirely (round 4: ~196 overlapped 252 MB
+point-RLC tables produced 26.5 G of fragmentation on 6 G of real
+temps).  The ragged remainder becomes ONE smaller tail call — never a
+fallback to the unchunked body, and never a collapse to a pathological
+chunk=1 scan.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def map_chunked(total: int, chunk: int, call):
+    """Run ``call(offset, width)`` over ``total`` items in ``chunk``-wide
+    sequential pieces, concatenating outputs on their leading axis.
+
+    ``call`` must return a pytree of arrays whose leading axis is
+    ``width``; ``offset`` is a traced int32 for the full chunks (use
+    ``lax.dynamic_slice_in_dim``) and a Python int for the tail.
+    ``chunk`` <= 0 or >= ``total`` degenerates to one direct call.
+    """
+    if not chunk or chunk >= total:
+        return call(0, total)
+    k, rem = divmod(total, chunk)
+    offs = jnp.arange(k, dtype=jnp.int32) * chunk
+    outs = lax.map(lambda off: call(off, chunk), offs)
+    outs = jax.tree_util.tree_map(
+        lambda o: o.reshape((k * chunk,) + tuple(o.shape[2:])), outs
+    )
+    if rem:
+        tail = call(k * chunk, rem)
+        outs = jax.tree_util.tree_map(
+            lambda o, t: jnp.concatenate([o, t], axis=0), outs, tail
+        )
+    return outs

@@ -159,7 +159,7 @@ def analyse(cfg: ce.CeremonyConfig, mesh, window: int, rho_bits: int) -> dict:
 
     pt = (n, t + 1, cs.ncoords, bf.limbs)
     args_verify = (
-        sds(pt, shard),  # a
+        sds((n, cs.ncoords, bf.limbs), shard),  # a0 = a[:, 0] only
         sds(pt, shard),  # e
         sds((n, n, fs.limbs), shard),  # s
         sds((n, n, fs.limbs), shard),  # r

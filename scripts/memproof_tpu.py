@@ -109,7 +109,7 @@ def main() -> int:
         )
         pt = (n, t + 1, cs.ncoords, bf.limbs)
         args_verify = (
-            sds(pt, shard),
+            sds((n, cs.ncoords, bf.limbs), shard),  # a0 = a[:, 0] only
             sds(pt, shard),
             sds((n, n, fs.limbs), shard),
             sds((n, n, fs.limbs), shard),
@@ -123,11 +123,15 @@ def main() -> int:
         # compiler message (the per-allocation breakdown is the whole
         # point) goes to a side file — JSON keeps a bounded excerpt.
         def try_compile(name, fn, args):
+            side = OUT.parent / f"MEMPROOF_TPU_{name}_error.txt"
             try:
-                return fn.lower(*args).compile()
+                exe = fn.lower(*args).compile()
+                # a stale error file from an earlier failed run would
+                # contradict the fresh ok=true artifact
+                side.unlink(missing_ok=True)
+                return exe
             except Exception as exc:  # noqa: BLE001 — record and move on
                 msg = str(exc)
-                side = OUT.parent / f"MEMPROOF_TPU_{name}_error.txt"
                 side.write_text(f"{type(exc).__name__}: {msg}\n")
                 report[name] = {
                     "ok": False,

@@ -110,7 +110,7 @@ def test_sharded_verify_finalise_chunked_matches_oneshot(monkeypatch):
 
     def run_once():
         ok, finals, master = pm.sharded_verify_finalise(
-            c.cfg, mesh, a, e, s, r, c.g_table, c.h_table, rho, rho_bits
+            c.cfg, mesh, a[:, 0], e, s, r, c.g_table, c.h_table, rho, rho_bits
         )
         return np.asarray(ok), np.asarray(finals), np.asarray(master)
 
@@ -124,9 +124,9 @@ def test_sharded_verify_finalise_chunked_matches_oneshot(monkeypatch):
 
     qual = jnp.asarray([i % 5 != 0 for i in range(n)])
     monkeypatch.setenv("DKG_TPU_VERIFY_CHUNK", "0")
-    fin2_ref, m2_ref = map(np.asarray, pm.sharded_finalise(c.cfg, mesh, a, s, qual))
+    fin2_ref, m2_ref = map(np.asarray, pm.sharded_finalise(c.cfg, mesh, a[:, 0], s, qual))
     monkeypatch.setenv("DKG_TPU_VERIFY_CHUNK", "2")
-    fin2_ch, m2_ch = map(np.asarray, pm.sharded_finalise(c.cfg, mesh, a, s, qual))
+    fin2_ch, m2_ch = map(np.asarray, pm.sharded_finalise(c.cfg, mesh, a[:, 0], s, qual))
     np.testing.assert_array_equal(fin2_ch, fin2_ref)
     np.testing.assert_array_equal(m2_ch, m2_ref)
 
