@@ -87,17 +87,28 @@ def deal(
       s (n, n, L)  share matrix s[j, i] = f_j(i+1)  (committee.rs:163-186)
       r (n, n, L)  hiding shares f'_j(i+1)
     """
-    cs = cfg.cs
-    fs = cs.scalar
-    a_pub = gd.fixed_base_mul(cs, g_table, coeffs_a)  # (n, t+1, C, L)
-    b_hid = gd.fixed_base_mul(cs, h_table, coeffs_b)
-    e_comm = gd.add(cs, a_pub, b_hid)
+    a_pub, e_comm = deal_commitments(cfg, coeffs_a, coeffs_b, g_table, h_table)
+    shares, hidings = deal_shares(cfg, coeffs_a, coeffs_b)
+    return a_pub, e_comm, shares, hidings
 
+
+def deal_commitments(cfg, coeffs_a, coeffs_b, g_table, h_table):
+    """Commitment half of dealing: (A, E) only (committee.rs:151-159)."""
+    cs = cfg.cs
+    a_pub = gd.fixed_base_mul(cs, g_table, coeffs_a)  # (m, t+1, C, L)
+    b_hid = gd.fixed_base_mul(cs, h_table, coeffs_b)
+    return a_pub, gd.add(cs, a_pub, b_hid)
+
+
+def deal_shares(cfg, coeffs_a, coeffs_b):
+    """Share half of dealing: the full share/hiding matrices
+    (committee.rs:163-186)."""
+    fs = cfg.cs.scalar
     xs = jnp.arange(1, cfg.n + 1, dtype=jnp.uint32)
     xs_limbs = jnp.zeros((cfg.n, fs.limbs), jnp.uint32).at[:, 0].set(xs)
-    shares = pdev.eval_many(fs, coeffs_a, xs_limbs)  # (n, n, L)
+    shares = pdev.eval_many(fs, coeffs_a, xs_limbs)  # (m, n, L)
     hidings = pdev.eval_many(fs, coeffs_b, xs_limbs)
-    return a_pub, e_comm, shares, hidings
+    return shares, hidings
 
 
 def _deal_chunk_default(cfg: CeremonyConfig, m: int | None = None) -> int:
@@ -183,25 +194,39 @@ def deal_chunked(
     return tuple(jnp.concatenate(parts, axis=0) for parts in zip(*outs))
 
 
-def deal_traced_chunked(
-    cfg: CeremonyConfig,
-    coeffs_a: jax.Array,
-    coeffs_b: jax.Array,
-    g_table: jax.Array,
-    h_table: jax.Array,
-):
-    """In-trace twin of :func:`deal_chunked` for sharded bodies.
+def _shares_chunk_default(cfg: CeremonyConfig, m: int) -> int:
+    """Dealer-axis chunk for the STANDALONE shares program
+    (:func:`deal_shares_traced_chunked`).
 
-    Inside ``shard_map`` a host loop cannot run, and an unrolled chunk
-    loop would let XLA overlap the chunks' temp buffers (they are
-    independent), defeating the memory bound — so chunks go through
-    ``lax.map`` (a scan): strictly sequential, temps reused.  The chunk
-    (``DKG_TPU_DEAL_CHUNK`` if set, else the default budget; 0 disables)
-    is honored exactly: k full chunks ride the map and a non-dividing
-    remainder becomes ONE smaller tail call (still within budget) —
-    never a fallback to the one-shot body the AOT lab showed rejected
-    at 21.3 GB (BLS n=16384 over 8 devices).
+    Its Horner carry is (w, n, L) u32 with the minor (n, L) dims
+    tile-padded — per dealer ~n * 128 * 4 B per matrix, two matrices.
+    The budget is what remains of 15 GiB after the program's arguments
+    (coefficients), its outputs (both share matrices), AND the
+    commitment tensors left RESIDENT by the first deal program — the
+    whole point of the two-program split is that the commitment scan's
+    temps are freed by then, so only real state is charged.
     """
+    cs = cfg.cs
+    pt_bytes = cs.ncoords * cs.field.limbs * 4
+    sc_bytes = cs.scalar.limbs * 4
+    io_bytes = (
+        2 * m * (cfg.t + 1) * sc_bytes  # coeffs in
+        + 2 * m * (cfg.t + 1) * pt_bytes  # resident a + e
+        + 2 * m * cfg.n * sc_bytes  # shares + hidings out
+    )
+    budget = min(25 << 28, max(1 << 30, (15 << 30) - io_bytes))
+    per_dealer = 2 * cfg.n * 128 * 4
+    chunk = max(1, budget // per_dealer)
+    return 1 << max(0, chunk.bit_length() - 1)
+
+
+def deal_commitments_traced_chunked(cfg, coeffs_a, coeffs_b, g_table, h_table):
+    """In-trace dealer-chunked commitment half (A, E) for sharded
+    bodies — the first of the two sequential deal programs (the split
+    lets XLA free this program's fixed-base scan carry before the
+    shares program allocates its Horner temps; the MONOLITHIC chunked
+    deal has a ~6.5 G temp floor that cannot coexist with its own
+    12.2 G of inputs+outputs at BLS n=16384 over 8 devices)."""
     from ..utils.scanchunk import map_chunked
 
     m = int(coeffs_a.shape[0])
@@ -212,7 +237,25 @@ def deal_traced_chunked(
     def call(off, w):
         ca = lax.dynamic_slice_in_dim(coeffs_a, off, w, 0)
         cb = lax.dynamic_slice_in_dim(coeffs_b, off, w, 0)
-        return deal(cfg, ca, cb, g_table, h_table)
+        return deal_commitments(cfg, ca, cb, g_table, h_table)
+
+    return map_chunked(m, chunk, call)
+
+
+def deal_shares_traced_chunked(cfg, coeffs_a, coeffs_b):
+    """In-trace dealer-chunked share half (s, r) — the second deal
+    program; see :func:`deal_commitments_traced_chunked`."""
+    from ..utils.scanchunk import map_chunked
+
+    m = int(coeffs_a.shape[0])
+    chunk = _deal_env_chunk()
+    if chunk is None:
+        chunk = _shares_chunk_default(cfg, m)
+
+    def call(off, w):
+        ca = lax.dynamic_slice_in_dim(coeffs_a, off, w, 0)
+        cb = lax.dynamic_slice_in_dim(coeffs_b, off, w, 0)
+        return deal_shares(cfg, ca, cb)
 
     return map_chunked(m, chunk, call)
 

@@ -134,21 +134,67 @@ def sharded_deal(
     exchanged as 32-byte per-dealer row digests
     (ce.sharded_transcript_digest) — both O(t + n), not O(n*t).
     """
+    a, e = sharded_deal_commitments(cfg, mesh, coeffs_a, coeffs_b, g_table, h_table)
+    s, r = sharded_deal_shares(cfg, mesh, coeffs_a, coeffs_b)
+    return a, e, s, r
+
+
+def sharded_deal_commitments(
+    cfg: ce.CeremonyConfig,
+    mesh: Mesh,
+    coeffs_a: jax.Array,
+    coeffs_b: jax.Array,
+    g_table: jax.Array,
+    h_table: jax.Array,
+):
+    """Round-1 commitment program: (A, E), dealer-sharded.
+
+    Dealing runs as TWO sequential programs (this one, then
+    :func:`sharded_deal_shares`) so the fixed-base scan's chunk carry
+    is freed before the Horner share evaluation allocates its temps —
+    the monolithic chunked deal keeps a ~6.5 G temp floor alive next
+    to 12.2 G of its own inputs+outputs at BLS n=16384 over 8 devices
+    (MEMPROOF_TPU round 5), which no chunk width can fit into a 16 GB
+    v5e.  Callers wanting the memory bound must NOT wrap both halves
+    in one outer jit — that fuses them back into one program.
+    """
     _check_mesh(cfg, mesh)
 
     @functools.partial(
         _shard_map_nocheck,
         mesh=mesh,
         in_specs=(P(PARTY_AXIS), P(PARTY_AXIS), P(), P()),
-        out_specs=(P(PARTY_AXIS), P(PARTY_AXIS), P(PARTY_AXIS), P(PARTY_AXIS)),
+        out_specs=(P(PARTY_AXIS), P(PARTY_AXIS)),
     )
     def step(ca, cb, gt, ht):
         # chunked in-trace (lax.map) so the fixed-base scan's padded
         # carry stays bounded per shard — the AOT TPU compile of the
         # one-shot body at BLS n=16384/8 devices was rejected at 21.3 GB
-        return ce.deal_traced_chunked(cfg, ca, cb, gt, ht)
+        return ce.deal_commitments_traced_chunked(cfg, ca, cb, gt, ht)
 
     return step(coeffs_a, coeffs_b, g_table, h_table)
+
+
+def sharded_deal_shares(
+    cfg: ce.CeremonyConfig,
+    mesh: Mesh,
+    coeffs_a: jax.Array,
+    coeffs_b: jax.Array,
+):
+    """Round-1 share program: (s, r), dealer-sharded (second of the two
+    sequential deal programs; see :func:`sharded_deal_commitments`)."""
+    _check_mesh(cfg, mesh)
+
+    @functools.partial(
+        _shard_map_nocheck,
+        mesh=mesh,
+        in_specs=(P(PARTY_AXIS), P(PARTY_AXIS)),
+        out_specs=(P(PARTY_AXIS), P(PARTY_AXIS)),
+    )
+    def step(ca, cb):
+        return ce.deal_shares_traced_chunked(cfg, ca, cb)
+
+    return step(coeffs_a, coeffs_b)
 
 
 def sharded_verify_finalise(

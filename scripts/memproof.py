@@ -152,10 +152,21 @@ def analyse(cfg: ce.CeremonyConfig, mesh, window: int, rho_bits: int) -> dict:
         sds((nw, 1 << window, cs.ncoords, bf.limbs), repl),  # h_table
     )
 
-    deal_fn = jax.jit(
-        lambda ca, cb, gt, ht: pmesh.sharded_deal(cfg, mesh, ca, cb, gt, ht)
+    # dealing is TWO sequential programs (commitments, then shares) —
+    # compiled separately, exactly as the engine executes them; one
+    # outer jit over sharded_deal would fuse them back into the
+    # monolith whose temp floor cannot fit beside its own outputs
+    # (mesh.sharded_deal_commitments docstring)
+    deal_commit_fn = jax.jit(
+        lambda ca, cb, gt, ht: pmesh.sharded_deal_commitments(
+            cfg, mesh, ca, cb, gt, ht
+        )
     )
-    deal_exec = deal_fn.lower(*args_deal).compile()
+    deal_commit_exec = deal_commit_fn.lower(*args_deal).compile()
+    deal_shares_fn = jax.jit(
+        lambda ca, cb: pmesh.sharded_deal_shares(cfg, mesh, ca, cb)
+    )
+    deal_shares_exec = deal_shares_fn.lower(*args_deal[:2]).compile()
 
     pt = (n, t + 1, cs.ncoords, bf.limbs)
     args_verify = (
@@ -205,11 +216,13 @@ def analyse(cfg: ce.CeremonyConfig, mesh, window: int, rho_bits: int) -> dict:
             "rho_bits": rho_bits,
         },
         "full_e_tensor_bytes": full_e_bytes,
-        "deal": phase_report(deal_exec),
+        "deal_commitments": phase_report(deal_commit_exec),
+        "deal_shares": phase_report(deal_shares_exec),
         "verify_finalise": phase_report(verify_exec),
     }
     worst = max(
-        report["deal"]["max_collective_bytes"],
+        report["deal_commitments"]["max_collective_bytes"],
+        report["deal_shares"]["max_collective_bytes"],
         report["verify_finalise"]["max_collective_bytes"],
     )
     report["never_replicates_e"] = worst < full_e_bytes
@@ -222,7 +235,11 @@ def analyse(cfg: ce.CeremonyConfig, mesh, window: int, rho_bits: int) -> dict:
     # plus the collective buffers — all O(n*t/ndev + n^2/ndev), never
     # O(n*t).
     resident = max(
-        report["deal"]["argument_bytes"] + report["deal"]["output_bytes"],
+        report["deal_commitments"]["argument_bytes"]
+        + report["deal_commitments"]["output_bytes"],
+        report["deal_commitments"]["output_bytes"]  # a+e stay resident
+        + report["deal_shares"]["argument_bytes"]
+        + report["deal_shares"]["output_bytes"],
         report["verify_finalise"]["argument_bytes"]
         + report["verify_finalise"]["output_bytes"]
         + report["verify_finalise"]["max_collective_bytes"],
