@@ -124,8 +124,27 @@ report(
     jax.jit(lambda ca, cb, gt, ht: ce.deal(cfg, ca, cb, gt, ht)).lower(*args_deal),
 )
 
-# the production path on TPU: deal in dealer chunks sized by
-# _deal_chunk_default (the padded-scan-carry OOM fix)
+# the production path on TPU since round 5: dealing is TWO sequential
+# programs (commitments, then shares), each dealer-chunked in-trace —
+# vet exactly what the engine runs, not the pre-split monolith (a shape
+# can pass the monolith compile and still have its real shares program
+# rejected)
+report(
+    "deal_commitments_chunked",
+    jax.jit(
+        lambda ca, cb, gt, ht: ce.deal_commitments_traced_chunked(
+            cfg, ca, cb, gt, ht
+        )
+    ).lower(*args_deal),
+)
+report(
+    "deal_shares_chunked",
+    jax.jit(lambda ca, cb: ce.deal_shares_traced_chunked(cfg, ca, cb)).lower(
+        *args_deal[:2]
+    ),
+)
+# the host-loop single-chip path (deal_chunked) compiles one chunk-sized
+# program per call; vet that program too
 chunk = ce._deal_chunk_default(cfg)
 if chunk < N:
     args_chunk = (

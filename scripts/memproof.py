@@ -234,13 +234,14 @@ def analyse(cfg: ce.CeremonyConfig, mesh, window: int, rho_bits: int) -> dict:
     # per-device argument+output footprint (the tensors that MUST exist)
     # plus the collective buffers — all O(n*t/ndev + n^2/ndev), never
     # O(n*t).
+    coeffs = report["deal_commitments"]["argument_bytes"]  # caller-held
     resident = max(
-        report["deal_commitments"]["argument_bytes"]
-        + report["deal_commitments"]["output_bytes"],
+        coeffs + report["deal_commitments"]["output_bytes"],
         report["deal_commitments"]["output_bytes"]  # a+e stay resident
-        + report["deal_shares"]["argument_bytes"]
+        + coeffs
         + report["deal_shares"]["output_bytes"],
-        report["verify_finalise"]["argument_bytes"]
+        coeffs  # still caller-held through verify (memproof_tpu model)
+        + report["verify_finalise"]["argument_bytes"]
         + report["verify_finalise"]["output_bytes"]
         + report["verify_finalise"]["max_collective_bytes"],
     )

@@ -260,9 +260,11 @@ def main() -> int:
             }
             peak = max(stages.values())
             report["hbm_v5e"] = {
-                "budget_bytes": 16 << 30,
+                "device_bytes": 16 << 30,
+                "reserved_bytes": 258 << 20,
+                "usable_bytes": usable,
                 "peak_bytes_per_device": int(peak),
-                "peak_fits": bool(peak < usable),
+                "peak_fits": bool(peak < usable),  # against usable_bytes
                 "note": (
                     "pipeline-stage accounting (see pipeline_resident_model) "
                     "— unlike the CPU MEMPROOF, temps reflect the real TPU "

@@ -38,6 +38,33 @@ NO_TESTS_COLLECTED = 5
 SHARDS: dict[str, int] = {}
 SLOW_SHARDS: dict[str, int] = {"test_ceremony.py": 4}
 
+# Files with no (or tiny) XLA compiles: batched into ONE pytest process
+# in the default tier.  A fresh interpreter + jax import costs ~3 s per
+# process on this 1-core box — across 16 light files that is ~50 s of
+# pure overhead, and their combined compile load is far below the level
+# where the XLA:CPU crash flake appears (crash isolation still guards
+# them: the whole batch retries as one unit).  Heavy (compile-bearing)
+# files keep process-per-file isolation.
+LIGHT_BATCH = {
+    "test_committee.py",
+    "test_complaint_storm.py",
+    "test_complaints_batch.py",
+    "test_crypto.py",
+    "test_curve_extension.py",
+    "test_device_hash.py",
+    "test_errors.py",
+    "test_groups_device.py",
+    "test_groups_host.py",
+    "test_import_hygiene.py",
+    "test_memproof.py",
+    "test_native.py",
+    "test_net.py",
+    "test_pallas_field.py",
+    "test_pallas_point.py",
+    "test_serde.py",
+    "test_tracing.py",
+}
+
 
 def _env() -> dict:
     env = dict(os.environ)
@@ -72,6 +99,19 @@ def run_file(path: str, extra: list[str], targets: list[str] | None = None) -> i
     return subprocess.call(cmd, cwd=REPO, env=_env())
 
 
+def run_with_retry(path: str, extra: list[str], targets: list[str] | None, label: str) -> int:
+    """THE retry policy: rerun up to twice when the process died on a
+    signal (the sporadic XLA:CPU compiler crash); real test failures
+    are never retried."""
+    rc = run_file(path, extra, targets)
+    for attempt in (1, 2):
+        if not (rc < 0 or rc >= 128):
+            break
+        print(f"[run_tests] {label} crashed (rc={rc}); retry {attempt}", flush=True)
+        rc = run_file(path, extra, targets)
+    return rc
+
+
 def main() -> int:
     # positional args select test files; flags pass through to pytest
     selected = [a for a in sys.argv[1:] if not a.startswith("-")
@@ -86,14 +126,26 @@ def main() -> int:
             return 2
     failures: list[str] = []
     t0 = time.time()
+    # Crash-isolation shards apply whenever the slow tests are
+    # INCLUDED in the run (explicit -m slow, or a bare invocation
+    # with no filter at all — the heaviest load of the three);
+    # only the default "not slow" tier is light enough to skip them.
+    includes_slow = not any("not slow" in a for a in extra)
+    if not includes_slow:
+        # default tier: one process for all the light files (they are
+        # only "light" with the slow marks deselected)
+        light = [f for f in files if os.path.basename(f) in LIGHT_BATCH]
+        files = [f for f in files if os.path.basename(f) not in LIGHT_BATCH]
+        if light:
+            t1 = time.time()
+            rc = run_with_retry(light[0], extra, light, "light batch")
+            if rc not in (0, NO_TESTS_COLLECTED):
+                failures.append("light-batch")
+            print(f"[run_tests] light batch ({len(light)} files): rc={rc} "
+                  f"({time.time()-t1:.0f}s)", flush=True)
     for path in files:
         name = os.path.basename(path)
         t1 = time.time()
-        # Crash-isolation shards apply whenever the slow tests are
-        # INCLUDED in the run (explicit -m slow, or a bare invocation
-        # with no filter at all — the heaviest load of the three);
-        # only the default "not slow" tier is light enough to skip them.
-        includes_slow = not any("not slow" in a for a in extra)
         nshards = (SLOW_SHARDS if includes_slow else SHARDS).get(name, 1)
         chunks: list[list[str] | None] = [None]
         if nshards > 1:
@@ -101,16 +153,7 @@ def main() -> int:
             if len(ids) >= nshards:
                 per = -(-len(ids) // nshards)
                 chunks = [ids[i : i + per] for i in range(0, len(ids), per)]
-        rcs = []
-        for chunk in chunks:
-            rc = run_file(path, extra, chunk)
-            for attempt in (1, 2):  # the flake is random; two retries
-                if not (rc < 0 or rc >= 128):
-                    break
-                print(f"[run_tests] {name} crashed (rc={rc}); retry {attempt}",
-                      flush=True)
-                rc = run_file(path, extra, chunk)
-            rcs.append(rc)
+        rcs = [run_with_retry(path, extra, chunk, name) for chunk in chunks]
         rc = next((r for r in rcs if r not in (0, NO_TESTS_COLLECTED)), rcs[0])
         if rc not in (0, NO_TESTS_COLLECTED):
             failures.append(name)
