@@ -12,7 +12,21 @@ encoding from utils.serde.
 
 Device-mesh ceremonies (dkg_tpu.parallel) ride ICI/DCN collectives
 instead; this layer is the host-side external-world boundary.
+
+Robustness: transports are first-publish-wins with equivocation
+evidence, TcpHubChannel retries with capped backoff under DKG_TPU_NET_*
+knobs, run_party quarantines malformed peer bytes, and net.faults adds
+a deterministic fault-injection harness (docs/fault_model.md).
 """
 
-from .channel import BroadcastChannel, InProcessChannel, TcpHub, TcpHubChannel  # noqa: F401
+from .channel import (  # noqa: F401
+    BroadcastChannel,
+    InProcessChannel,
+    RetryBudgetExceeded,
+    TcpHub,
+    TcpHubChannel,
+    TransportError,
+    TruncatedStream,
+)
+from .faults import CrashFault, FaultPlan, FaultyChannel  # noqa: F401
 from .party import PartyResult, run_party  # noqa: F401

@@ -7,6 +7,25 @@ with nothing to say publishes the empty payload (the protocol's
 ``None`` broadcast); a party that never publishes is simply absent from
 the fetch — both map to silent disqualification downstream.
 
+Robustness posture (see docs/fault_model.md):
+
+* **First-publish-wins.**  A second, *different* publish for the same
+  (round, sender) never replaces the first; it is recorded as an
+  equivocation attempt so the ceremony operator can surface evidence.
+  An identical re-publish is a no-op, which makes publish retries
+  idempotent and safe.
+* **Typed transport errors.**  Short reads raise
+  :class:`TruncatedStream` (a :class:`TransportError`), never a bare
+  ``EOFError``, so callers can retry transport faults without masking
+  programming errors.
+* **Retry with capped exponential backoff + jitter.**  Every
+  ``TcpHubChannel`` RPC retries transient socket failures under
+  configurable attempt/timeout budgets (``DKG_TPU_NET_*`` knobs via
+  utils.envknobs).
+* **Whole-ceremony fetch budget.**  ``TcpHubChannel`` can clamp every
+  fetch to the remainder of one ceremony-wide deadline instead of
+  paying a flat per-round timeout for each silent party.
+
 ``TcpHub`` is a minimal length-prefixed TCP mailbox for multi-process
 ceremonies; authenticity/transport security is the deployment's job,
 exactly as the reference assumes an *authenticated* channel.
@@ -14,15 +33,43 @@ exactly as the reference assumes an *authenticated* channel.
 
 from __future__ import annotations
 
+import random
 import socket
 import socketserver
 import struct
 import threading
 import time
-from typing import Protocol
+from typing import Optional, Protocol
+
+from ..utils import envknobs
 
 _OP_PUB = 1
 _OP_FETCH = 2
+_OP_EVID = 3
+
+# How many distinct payloads (the original + alternates) to retain per
+# equivocating (round, sender) as evidence before only counting.
+_EVIDENCE_CAP = 8
+
+# Ceiling for one backoff step, regardless of attempt count.
+_BACKOFF_CAP_S = 2.0
+
+# Defaults for the DKG_TPU_NET_* knobs (see docs/fault_model.md).
+_DEFAULT_IO_TIMEOUT_S = 60.0
+_DEFAULT_ATTEMPTS = 4
+_DEFAULT_BACKOFF_MS = 50.0
+
+
+class TransportError(RuntimeError):
+    """A transport-layer failure (retryable; never a protocol error)."""
+
+
+class TruncatedStream(TransportError):
+    """The peer closed the stream mid-message (short read)."""
+
+
+class RetryBudgetExceeded(TransportError):
+    """All RPC attempts failed; carries the last underlying error."""
 
 
 class BroadcastChannel(Protocol):
@@ -40,16 +87,31 @@ class BroadcastChannel(Protocol):
 class InProcessChannel:
     """Shared-memory channel for in-process multi-party simulation —
     the reference's test transport (committee.rs:1337-1338) with real
-    blocking semantics so threaded parties interleave correctly."""
+    blocking semantics so threaded parties interleave correctly.
+
+    Publishes are first-write-wins: a conflicting second publish for
+    the same (round, sender) is recorded in the equivocation log, not
+    applied; an identical re-publish (a retry) is a silent no-op."""
 
     def __init__(self) -> None:
         self._lock = threading.Condition()
         self._rounds: dict[int, dict[int, bytes]] = {}
+        # (round, sender) -> [first payload, alternate, ...] (capped)
+        self._equivocations: dict[tuple[int, int], list[bytes]] = {}
 
     def publish(self, round_no: int, sender: int, payload: bytes) -> None:
         with self._lock:
-            self._rounds.setdefault(round_no, {})[sender] = payload
-            self._lock.notify_all()
+            mailbox = self._rounds.setdefault(round_no, {})
+            prev = mailbox.get(sender)
+            if prev is None:
+                mailbox[sender] = payload
+                self._lock.notify_all()
+            elif prev != payload:
+                ev = self._equivocations.setdefault((round_no, sender), [prev])
+                # evidence holds *distinct* payloads: a retry of an
+                # already-recorded conflicting publish adds nothing
+                if payload not in ev and len(ev) < _EVIDENCE_CAP:
+                    ev.append(payload)
 
     def fetch(self, round_no: int, expected: int, timeout: float = 30.0) -> dict[int, bytes]:
         deadline = time.monotonic() + timeout
@@ -62,6 +124,12 @@ class InProcessChannel:
                 if remaining <= 0:
                     return dict(got)
                 self._lock.wait(remaining)
+
+    def equivocation_evidence(self) -> dict[tuple[int, int], tuple[bytes, ...]]:
+        """All observed equivocations: (round, sender) -> distinct payloads,
+        first-published first.  Empty dict when every sender was consistent."""
+        with self._lock:
+            return {k: tuple(v) for k, v in self._equivocations.items()}
 
 
 class _HubHandler(socketserver.StreamRequestHandler):
@@ -84,7 +152,13 @@ class _HubHandler(socketserver.StreamRequestHandler):
                     out.append(struct.pack("<II", sender, len(payload)))
                     out.append(payload)
                 self.wfile.write(b"".join(out))
-        except (ConnectionError, EOFError):
+            elif op == _OP_EVID:
+                ev = hub.channel.equivocation_evidence()
+                out = [struct.pack("<I", len(ev))]
+                for (round_no, sender), payloads in sorted(ev.items()):
+                    out.append(struct.pack("<III", round_no, sender, len(payloads)))
+                self.wfile.write(b"".join(out))
+        except (ConnectionError, TransportError):
             pass
 
 
@@ -93,14 +167,16 @@ def _read_exact(f, n: int) -> bytes:
     while len(buf) < n:
         chunk = f.read(n - len(buf))
         if not chunk:
-            raise EOFError
+            raise TruncatedStream(f"stream closed after {len(buf)}/{n} bytes")
         buf += chunk
     return buf
 
 
 class TcpHub:
     """The mailbox server: one per ceremony, any party (or a neutral
-    host) can run it.  Threaded: each publish/fetch is one connection."""
+    host) can run it.  Threaded: each publish/fetch is one connection.
+    First-publish-wins and the equivocation log come from the backing
+    :class:`InProcessChannel`."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
         class _Server(socketserver.ThreadingTCPServer):
@@ -123,25 +199,110 @@ class TcpHub:
 
 
 class TcpHubChannel:
-    """Client side of TcpHub; satisfies BroadcastChannel."""
+    """Client side of TcpHub; satisfies BroadcastChannel.
 
-    def __init__(self, host: str, port: int) -> None:
+    Transient socket failures are retried with capped exponential
+    backoff + jitter; ``stats`` counts what happened so the party
+    driver can surface it (net.party threads the counters into
+    PartyResult / CeremonyTrace).
+
+    Knobs (constructor arguments override; validated via
+    utils.envknobs):
+
+    * ``DKG_TPU_NET_TIMEOUT_S``  — per-RPC socket I/O timeout (default 60)
+    * ``DKG_TPU_NET_ATTEMPTS``   — RPC attempts before giving up (default 4)
+    * ``DKG_TPU_NET_BACKOFF_MS`` — base backoff between attempts (default 50)
+    * ``DKG_TPU_NET_BUDGET_S``   — whole-ceremony fetch budget (default off)
+
+    When the budget is set, the first operation arms one ceremony-wide
+    deadline and every subsequent ``fetch`` is clamped to the remaining
+    budget, so k silent parties cost one shared budget, not k full
+    per-round timeouts.
+    """
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        *,
+        attempts: Optional[int] = None,
+        io_timeout_s: Optional[float] = None,
+        backoff_ms: Optional[float] = None,
+        budget_s: Optional[float] = None,
+        rng: Optional[random.Random] = None,
+    ) -> None:
         self._addr = (host, port)
+        if attempts is None:
+            attempts = envknobs.pos_int(
+                "DKG_TPU_NET_ATTEMPTS", "RPC attempts before giving up"
+            )
+        if io_timeout_s is None:
+            io_timeout_s = envknobs.pos_float(
+                "DKG_TPU_NET_TIMEOUT_S", "per-RPC socket timeout in seconds"
+            )
+        if backoff_ms is None:
+            backoff_ms = envknobs.nonneg_float(
+                "DKG_TPU_NET_BACKOFF_MS", "base retry backoff in milliseconds"
+            )
+        if budget_s is None:
+            budget_s = envknobs.pos_float(
+                "DKG_TPU_NET_BUDGET_S", "whole-ceremony fetch budget in seconds"
+            )
+        self._attempts = attempts if attempts is not None else _DEFAULT_ATTEMPTS
+        self._io_timeout_s = (
+            io_timeout_s if io_timeout_s is not None else _DEFAULT_IO_TIMEOUT_S
+        )
+        self._backoff_s = (
+            backoff_ms if backoff_ms is not None else _DEFAULT_BACKOFF_MS
+        ) / 1000.0
+        self._budget_s = budget_s
+        self._deadline: Optional[float] = None
+        self._rng = rng if rng is not None else random.Random()
+        self.stats: dict[str, int] = {"rpcs": 0, "retries": 0, "budget_clamps": 0}
 
-    def _rpc(self, payload: bytes, read_reply) -> object:
-        with socket.create_connection(self._addr, timeout=60.0) as s:
-            s.sendall(payload)
-            f = s.makefile("rb")
-            return read_reply(f)
+    # -- deadline budget ----------------------------------------------------
+
+    def _budget_remaining(self) -> Optional[float]:
+        """Arm the ceremony deadline on first use; None when budget is off."""
+        if self._budget_s is None:
+            return None
+        if self._deadline is None:
+            self._deadline = time.monotonic() + self._budget_s
+        return max(0.0, self._deadline - time.monotonic())
+
+    # -- retrying RPC core --------------------------------------------------
+
+    def _rpc(self, payload: bytes, read_reply, io_timeout: float) -> object:
+        self.stats["rpcs"] += 1
+        last: Optional[Exception] = None
+        for attempt in range(self._attempts):
+            if attempt:
+                self.stats["retries"] += 1
+                step = min(_BACKOFF_CAP_S, self._backoff_s * (2 ** (attempt - 1)))
+                time.sleep(step * (0.5 + self._rng.random()))
+            try:
+                with socket.create_connection(self._addr, timeout=io_timeout) as s:
+                    s.sendall(payload)
+                    f = s.makefile("rb")
+                    return read_reply(f)
+            except (OSError, TransportError) as exc:
+                last = exc
+        raise RetryBudgetExceeded(
+            f"{self._attempts} attempt(s) to {self._addr} failed: {last!r}"
+        )
 
     def publish(self, round_no: int, sender: int, payload: bytes) -> None:
+        self._budget_remaining()  # arm the ceremony deadline
         msg = bytes([_OP_PUB]) + struct.pack("<III", round_no, sender, len(payload)) + payload
-        self._rpc(msg, lambda f: _read_exact(f, 1))
+        self._rpc(msg, lambda f: _read_exact(f, 1), self._io_timeout_s)
 
     def fetch(self, round_no: int, expected: int, timeout: float = 30.0) -> dict[int, bytes]:
-        msg = bytes([_OP_FETCH]) + struct.pack(
-            "<III", round_no, expected, int(timeout * 1000)
-        )
+        remaining = self._budget_remaining()
+        if remaining is not None and remaining < timeout:
+            self.stats["budget_clamps"] += 1
+            timeout = remaining
+        timeout_ms = min(int(timeout * 1000), 0xFFFFFFFF)
+        msg = bytes([_OP_FETCH]) + struct.pack("<III", round_no, expected, timeout_ms)
 
         def read_reply(f) -> dict[int, bytes]:
             (count,) = struct.unpack("<I", _read_exact(f, 4))
@@ -151,4 +312,21 @@ class TcpHubChannel:
                 out[sender] = _read_exact(f, ln)
             return out
 
-        return self._rpc(msg, read_reply)
+        # The hub blocks up to ``timeout`` before replying, so the socket
+        # deadline must cover the wait *plus* normal I/O slack.
+        return self._rpc(msg, read_reply, timeout + self._io_timeout_s)
+
+    def equivocation_counts(self) -> dict[tuple[int, int], int]:
+        """(round, sender) -> number of distinct payloads the hub saw
+        (>= 2 means the sender equivocated)."""
+        msg = bytes([_OP_EVID])
+
+        def read_reply(f) -> dict[tuple[int, int], int]:
+            (count,) = struct.unpack("<I", _read_exact(f, 4))
+            out: dict[tuple[int, int], int] = {}
+            for _ in range(count):
+                round_no, sender, n = struct.unpack("<III", _read_exact(f, 12))
+                out[(round_no, sender)] = n
+            return out
+
+        return self._rpc(msg, read_reply, self._io_timeout_s)

@@ -1,0 +1,303 @@
+"""Deterministic fault injection for channel-driven ceremonies.
+
+The engine has a fault hook already — ``BatchedCeremony.run(tamper=)``
+corrupts device arrays after dealing (dkg_tpu/dkg/ceremony.py).  This
+module is the wire-level analogue for the net layer: a seeded
+:class:`FaultPlan` schedules byte-level and liveness faults against
+specific (round, sender) messages, and :class:`FaultyChannel` applies
+them on top of any :class:`~dkg_tpu.net.channel.BroadcastChannel`.
+
+Every mutation is derived from ``(seed, round, sender, kind)`` only, so
+a plan replays byte-for-byte: the same seed produces the same garbage,
+the same flipped bit, and the same outcome — chaos tests are ordinary
+deterministic tests (tests/test_chaos.py), and a failing soak seed from
+scripts/chaos_storm.py reproduces locally.
+
+Fault vocabulary (all scheduled per (round, sender)):
+
+* ``drop``       — the publish never happens (silent dropout).
+* ``delay``      — the publish lands late; peers that already fetched
+                   treat it as missing.
+* ``garbage``    — the payload is replaced with seeded random bytes.
+* ``truncate``   — only a prefix of the payload is published.
+* ``bitflip``    — one seeded bit of the payload is inverted.
+* ``replace``    — the payload is replaced with caller-chosen bytes
+                   (for handcrafted adversarial messages).
+* ``duplicate``  — the same payload is published twice (an idempotent
+                   retry; must NOT count as equivocation).
+* ``equivocate`` — a second, different payload is also published; the
+                   channel keeps the first and records evidence.
+* ``crash``      — via :meth:`FaultPlan.crash_after`: the party dies
+                   before any operation on a later round
+                   (:class:`CrashFault` propagates out of run_party,
+                   modelling a process crash).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+from .channel import BroadcastChannel
+from .party import PartyResult, run_party
+
+_KIND_CODES = {
+    "drop": 1,
+    "delay": 2,
+    "garbage": 3,
+    "truncate": 4,
+    "bitflip": 5,
+    "replace": 6,
+    "duplicate": 7,
+    "equivocate": 8,
+}
+
+
+class CrashFault(RuntimeError):
+    """Simulated process crash of one party (not a protocol error)."""
+
+
+class FaultPlan:
+    """A seeded, replayable schedule of wire faults for one ceremony.
+
+    Builder methods return ``self`` so plans chain::
+
+        plan = (FaultPlan(seed=7)
+                .garbage(1, sender=2)
+                .equivocate(3, sender=5)
+                .crash_after(sender=7, round_no=2))
+    """
+
+    def __init__(self, seed: int = 0) -> None:
+        self.seed = int(seed)
+        # (round, sender) -> [(kind, arg), ...] in scheduling order
+        self._faults: dict[tuple[int, int], list[tuple[str, object]]] = {}
+        self._crash_after: dict[int, int] = {}  # sender -> last completed round
+
+    # -- builders -----------------------------------------------------------
+
+    def _add(self, kind: str, round_no: int, sender: int, arg: object = None) -> "FaultPlan":
+        self._faults.setdefault((round_no, sender), []).append((kind, arg))
+        return self
+
+    def drop(self, round_no: int, sender: int) -> "FaultPlan":
+        return self._add("drop", round_no, sender)
+
+    def delay(self, round_no: int, sender: int, seconds: float) -> "FaultPlan":
+        return self._add("delay", round_no, sender, float(seconds))
+
+    def garbage(self, round_no: int, sender: int, nbytes: Optional[int] = None) -> "FaultPlan":
+        return self._add("garbage", round_no, sender, nbytes)
+
+    def truncate(self, round_no: int, sender: int, keep: Optional[int] = None) -> "FaultPlan":
+        return self._add("truncate", round_no, sender, keep)
+
+    def bitflip(self, round_no: int, sender: int) -> "FaultPlan":
+        return self._add("bitflip", round_no, sender)
+
+    def replace(self, round_no: int, sender: int, payload: bytes) -> "FaultPlan":
+        return self._add("replace", round_no, sender, bytes(payload))
+
+    def duplicate(self, round_no: int, sender: int) -> "FaultPlan":
+        return self._add("duplicate", round_no, sender)
+
+    def equivocate(
+        self, round_no: int, sender: int, alternate: Optional[bytes] = None
+    ) -> "FaultPlan":
+        return self._add("equivocate", round_no, sender, alternate)
+
+    def crash_after(self, sender: int, round_no: int) -> "FaultPlan":
+        """Party ``sender`` completes ``round_no`` and then dies: any
+        publish/fetch for a later round raises :class:`CrashFault`."""
+        self._crash_after[sender] = min(
+            round_no, self._crash_after.get(sender, round_no)
+        )
+        return self
+
+    # -- queries ------------------------------------------------------------
+
+    def faults_for(self, round_no: int, sender: int) -> list[tuple[str, object]]:
+        return list(self._faults.get((round_no, sender), ()))
+
+    def crashes_at(self, sender: int, round_no: int) -> bool:
+        last_ok = self._crash_after.get(sender)
+        return last_ok is not None and round_no > last_ok
+
+    def as_dict(self) -> dict:
+        """JSON-able description (for CHAOS.json / failure reports)."""
+        return {
+            "seed": self.seed,
+            "faults": [
+                {
+                    "round": r,
+                    "sender": s,
+                    "kind": kind,
+                    "arg": arg if not isinstance(arg, bytes) else arg.hex(),
+                }
+                for (r, s), lst in sorted(self._faults.items())
+                for kind, arg in lst
+            ],
+            # string keys so the dict round-trips through JSON unchanged
+            "crash_after": {str(s): r for s, r in sorted(self._crash_after.items())},
+        }
+
+    # -- deterministic mutation helpers -------------------------------------
+
+    def _rng(self, round_no: int, sender: int, kind: str) -> random.Random:
+        # Mix the coordinates into one integer seed; Python int hashing of
+        # plain ints is stable, but avoid hash() anyway so the stream is
+        # independent of PYTHONHASHSEED by construction.
+        mixed = (
+            (self.seed & 0xFFFFFFFF) << 32
+            | (round_no & 0xFF) << 24
+            | (sender & 0xFFFF) << 8
+            | _KIND_CODES[kind]
+        )
+        return random.Random(mixed)
+
+    def garbage_bytes(self, round_no: int, sender: int, nbytes: Optional[int]) -> bytes:
+        rng = self._rng(round_no, sender, "garbage")
+        n = nbytes if nbytes is not None else rng.randrange(1, 256)
+        return rng.randbytes(n)
+
+    def flip_one_bit(self, round_no: int, sender: int, payload: bytes) -> bytes:
+        if not payload:
+            return b"\x01"
+        rng = self._rng(round_no, sender, "bitflip")
+        pos = rng.randrange(len(payload) * 8)
+        out = bytearray(payload)
+        out[pos // 8] ^= 1 << (pos % 8)
+        return bytes(out)
+
+    def truncate_bytes(
+        self, round_no: int, sender: int, payload: bytes, keep: Optional[int]
+    ) -> bytes:
+        if keep is None:
+            keep = self._rng(round_no, sender, "truncate").randrange(max(1, len(payload)))
+        return payload[:keep]
+
+
+class FaultyChannel:
+    """Apply a :class:`FaultPlan` on top of any broadcast channel.
+
+    One wrapper serves one party (``party`` is its 1-based index): crash
+    faults key off the party, payload faults off the publish's sender —
+    which for a well-behaved driver is the same index.  Everything not
+    scheduled passes straight through, and unknown attributes delegate
+    to the wrapped channel (``stats``, ``equivocation_evidence``, ...).
+    """
+
+    def __init__(self, inner: BroadcastChannel, plan: FaultPlan, party: int) -> None:
+        self._inner = inner
+        self._plan = plan
+        self._party = party
+
+    def _check_crash(self, round_no: int) -> None:
+        if self._plan.crashes_at(self._party, round_no):
+            raise CrashFault(f"party {self._party} crashed before round {round_no}")
+
+    def publish(self, round_no: int, sender: int, payload: bytes) -> None:
+        self._check_crash(round_no)
+        plan = self._plan
+        publishes = [payload]
+        for kind, arg in plan.faults_for(round_no, sender):
+            if kind == "drop":
+                return
+            elif kind == "delay":
+                time.sleep(float(arg))  # type: ignore[arg-type]
+            elif kind == "garbage":
+                publishes = [plan.garbage_bytes(round_no, sender, arg)]  # type: ignore[arg-type]
+            elif kind == "truncate":
+                publishes = [
+                    plan.truncate_bytes(round_no, sender, publishes[0], arg)  # type: ignore[arg-type]
+                ]
+            elif kind == "bitflip":
+                publishes = [plan.flip_one_bit(round_no, sender, publishes[0])]
+            elif kind == "replace":
+                publishes = [arg]  # type: ignore[list-item]
+            elif kind == "duplicate":
+                publishes.append(publishes[-1])
+            elif kind == "equivocate":
+                alt = arg if arg is not None else plan.flip_one_bit(round_no, sender, publishes[-1])
+                publishes.append(alt)  # type: ignore[arg-type]
+        for p in publishes:
+            self._inner.publish(round_no, sender, p)
+
+    def fetch(self, round_no: int, expected: int, timeout: float = 30.0) -> dict[int, bytes]:
+        self._check_crash(round_no)
+        return self._inner.fetch(round_no, expected, timeout)
+
+    def __getattr__(self, name: str):
+        return getattr(self._inner, name)
+
+
+# ---------------------------------------------------------------------------
+# chaos harness: threaded n-party ceremonies under a fault plan
+# ---------------------------------------------------------------------------
+
+
+def make_committee(group, n: int, t: int, seed: int, shared_string: bytes = b"chaos"):
+    """Deterministic committee setup: (env, sorted keys, sorted pks)."""
+    from ..dkg.committee import Environment
+    from ..dkg.procedure_keys import MemberCommunicationKey, sort_committee
+
+    rng = random.Random(seed)
+    env = Environment.init(group, t, n, shared_string)
+    keys = [MemberCommunicationKey.generate(group, rng) for _ in range(n)]
+    pks = sort_committee(group, [k.public() for k in keys])
+    by_pk = {group.encode(k.public().point): k for k in keys}
+    sorted_keys = [by_pk[group.encode(p.point)] for p in pks]
+    return env, sorted_keys, pks
+
+
+def run_with_faults(
+    env,
+    keys,
+    pks,
+    plan: FaultPlan,
+    channel_factory: Callable[[int], BroadcastChannel],
+    timeout: float = 5.0,
+    seed: int = 0,
+    join_timeout: float = 300.0,
+):
+    """Run a full threaded ceremony with ``plan`` applied to every party.
+
+    ``channel_factory(i)`` returns party ``i``'s (0-based) base channel —
+    a shared :class:`InProcessChannel` or one ``TcpHubChannel`` each.
+    Returns a list of per-party outcomes: :class:`PartyResult`, a
+    :class:`CrashFault` for crashed parties, or the raised exception if
+    a party died for any other reason (a harness bug, never expected).
+    """
+    n = env.nr_members
+    results: list[object] = [None] * n
+
+    def worker(i: int) -> None:
+        chan = FaultyChannel(channel_factory(i), plan, party=i + 1)
+        try:
+            results[i] = run_party(
+                chan, env, keys[i], pks, i + 1, random.Random(seed * 6151 + i), timeout=timeout
+            )
+        except CrashFault as cf:
+            results[i] = cf
+        except Exception as exc:  # noqa: BLE001 — surfaced to the caller verbatim
+            results[i] = exc
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=join_timeout)
+    return results
+
+
+def honest_results(results, plan: FaultPlan) -> list[PartyResult]:
+    """The PartyResults of parties the plan never touched (1-based
+    untouched indices), in index order."""
+    touched = {s for (_, s) in plan._faults} | set(plan._crash_after)
+    return [
+        r
+        for i, r in enumerate(results)
+        if (i + 1) not in touched and isinstance(r, PartyResult)
+    ]

@@ -7,6 +7,16 @@ channel; rounds are published/fetched as deterministic wire bytes
 (utils.serde), malformed or missing messages degrade to the protocol's
 silent-disqualification semantics (reference: committee.rs:844-853).
 
+The wire boundary is a trust boundary.  Every peer payload is decoded
+inside :func:`_decode_quarantined` (any decode failure -> ``None`` ->
+the *sender* is silently disqualified, exactly as if it had never
+published) and then shape/index-validated before it reaches the
+committee state machine — a Byzantine peer must never be able to crash
+an honest party with bytes alone (see docs/fault_model.md and the
+regression suite in tests/test_chaos.py).  ``PartyResult`` counts what
+the transport survived (quarantined peers, round timeouts, RPC
+retries) and threads the counters into utils.tracing.
+
 A party that hits a protocol-fatal error still publishes its complaint
 evidence first (reference: committee.rs:340-347) and then publishes
 empty payloads for the remaining rounds so peers never block on it.
@@ -14,7 +24,8 @@ empty payloads for the remaining rounds so peers never block on it.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+import struct
+from dataclasses import dataclass, field
 from typing import Optional
 
 from ..dkg.committee import (
@@ -34,6 +45,7 @@ from ..dkg.procedure_keys import (
     MemberSecretShare,
 )
 from ..utils import serde
+from ..utils.tracing import CeremonyTrace, phase_span
 from .channel import BroadcastChannel
 
 
@@ -43,10 +55,54 @@ class PartyResult:
     master: Optional[MasterPublicKey] = None
     share: Optional[MemberSecretShare] = None
     error: Optional[DkgError] = None
+    # transport/robustness counters (mirrored into ``trace.counters``)
+    quarantined: int = 0  # peer messages that failed decode/validation
+    timeouts: int = 0  # rounds that closed before all n messages arrived
+    retries: int = 0  # channel RPC retries (channels exposing .stats)
+    trace: Optional[CeremonyTrace] = field(default=None, repr=False)
 
     @property
     def ok(self) -> bool:
         return self.error is None and self.master is not None
+
+
+def _decode_quarantined(decoder, group, payload: bytes):
+    """Decode one peer payload; ANY failure means ``None`` (the sender is
+    silently disqualified, like a party that never published).  Malformed
+    bytes from a Byzantine peer must never raise into ``run_party`` —
+    scripts/lint_lite.py (DKG001) pins every net-layer decode to this
+    quarantine."""
+    try:
+        return decoder(group, payload)
+    except (ValueError, struct.error, IndexError, OverflowError):
+        return None
+
+
+def _index_ok(n: int, *indices: int) -> bool:
+    return all(1 <= i <= n for i in indices)
+
+
+def _valid_phase1(b, n: int) -> bool:
+    # every recipient 1..n must appear exactly once: a dealing that omits
+    # (or duplicates) recipients could otherwise make an honest party
+    # abort with FETCHED_INVALID_DATA instead of disqualifying the dealer
+    return sorted(es.recipient_index for es in b.encrypted_shares) == list(
+        range(1, n + 1)
+    )
+
+
+def _valid_phase2(b, n: int) -> bool:
+    return all(_index_ok(n, m.accused_index) for m in b.misbehaving_parties)
+
+
+def _valid_phase4(b, n: int) -> bool:
+    return all(_index_ok(n, m.accused_index) for m in b.misbehaving_parties)
+
+
+def _valid_phase5(b, n: int) -> bool:
+    return all(
+        _index_ok(n, d.accused_index, d.holder_index) for d in b.disclosed_shares
+    )
 
 
 def _publish(channel, round_no: int, my: int, payload: Optional[bytes]) -> None:
@@ -68,85 +124,118 @@ def run_party(
     my: int,
     rng,
     timeout: float = 30.0,
+    trace: Optional[CeremonyTrace] = None,
 ) -> PartyResult:
     """Execute one party's side of the ceremony over ``channel``.
 
     ``my`` is the party's 1-based index in the byte-sorted committee
     (reference: committee.rs:134-135); returns the master public key and
-    this party's secret share on success.
+    this party's secret share on success.  Pass a
+    :class:`~dkg_tpu.utils.tracing.CeremonyTrace` to collect per-round
+    wall-clock and the quarantine/timeout/retry counters.
     """
     group = env.group
     n = env.nr_members
     others = [j for j in range(1, n + 1) if j != my]
+    result = PartyResult(my, trace=trace)
 
     def fetch(round_no: int) -> dict[int, bytes]:
-        return channel.fetch(round_no, n, timeout)
+        got = channel.fetch(round_no, n, timeout)
+        if len(got) < n:
+            result.timeouts += 1
+        return got
+
+    def decoded(got: dict[int, bytes], j: int, decoder, validate):
+        payload = got.get(j)
+        if not payload:
+            return None  # absent or explicit empty: silent disqualification
+        b = _decode_quarantined(decoder, group, payload)
+        if b is not None and not validate(b, n):
+            b = None
+        if b is None:
+            result.quarantined += 1
+        return b
+
+    def finish(res: PartyResult) -> PartyResult:
+        stats = getattr(channel, "stats", None)
+        if isinstance(stats, dict):
+            res.retries = int(stats.get("retries", 0))
+        if trace is not None:
+            trace.bump("net.quarantined", res.quarantined)
+            trace.bump("net.round_timeouts", res.timeouts)
+            trace.bump("net.rpc_retries", res.retries)
+            trace.meta.setdefault("party_index", my)
+        return res
 
     # ---- round 1: dealing ------------------------------------------------
-    phase1, b1 = DistributedKeyGeneration.init(env, rng, comm_key, committee_pks, my)
-    _publish(channel, 1, my, serde.encode_phase1(group, b1))
-    got1 = fetch(1)
-    fetched1 = [
-        FetchedPhase1.from_broadcast(
-            env, j, serde.decode_phase1(group, got1[j]) if got1.get(j) else None
-        )
-        for j in others
-    ]
+    with phase_span(trace, "net_round1", annotate_device=False):
+        phase1, b1 = DistributedKeyGeneration.init(env, rng, comm_key, committee_pks, my)
+        _publish(channel, 1, my, serde.encode_phase1(group, b1))
+        got1 = fetch(1)
+        fetched1 = [
+            FetchedPhase1.from_broadcast(
+                env, j, decoded(got1, j, serde.decode_phase1, _valid_phase1)
+            )
+            for j in others
+        ]
 
     # ---- round 2: share verification + complaints ------------------------
-    nxt, b2 = phase1.proceed(fetched1, rng)
-    _publish(channel, 2, my, serde.encode_phase2(group, b2) if b2 else None)
-    if isinstance(nxt, DkgError):
-        return _drain(channel, my, 3, PartyResult(my, error=nxt))
-    got2 = fetch(2)
-    complaints2 = [
-        FetchedComplaints2(
-            j, serde.decode_phase2(group, got2[j]) if got2.get(j) else None
-        )
-        for j in others
-    ]
+    with phase_span(trace, "net_round2", annotate_device=False):
+        nxt, b2 = phase1.proceed(fetched1, rng)
+        _publish(channel, 2, my, serde.encode_phase2(group, b2) if b2 else None)
+        if isinstance(nxt, DkgError):
+            result.error = nxt
+            return finish(_drain(channel, my, 3, result))
+        got2 = fetch(2)
+        complaints2 = [
+            FetchedComplaints2(j, decoded(got2, j, serde.decode_phase2, _valid_phase2))
+            for j in others
+        ]
 
     # ---- round 3: qualified set + bare commitments -----------------------
-    nxt, b3 = nxt.proceed(complaints2, fetched1)
-    if isinstance(nxt, DkgError):
-        return _drain(channel, my, 3, PartyResult(my, error=nxt))
-    _publish(channel, 3, my, serde.encode_phase3(group, b3) if b3 else None)
-    got3 = fetch(3)
-    fetched3 = [
-        FetchedPhase3.from_broadcast(
-            env, j, serde.decode_phase3(group, got3[j]) if got3.get(j) else None
-        )
-        for j in others
-    ]
+    with phase_span(trace, "net_round3", annotate_device=False):
+        nxt, b3 = nxt.proceed(complaints2, fetched1)
+        if isinstance(nxt, DkgError):
+            result.error = nxt
+            return finish(_drain(channel, my, 3, result))
+        _publish(channel, 3, my, serde.encode_phase3(group, b3) if b3 else None)
+        got3 = fetch(3)
+        fetched3 = [
+            FetchedPhase3.from_broadcast(
+                env, j, decoded(got3, j, serde.decode_phase3, lambda b, n: True)
+            )
+            for j in others
+        ]
 
     # ---- round 4: re-verification + disclosure complaints ----------------
-    nxt, b4 = nxt.proceed(fetched3)
-    _publish(channel, 4, my, serde.encode_phase4(group, b4) if b4 else None)
-    if isinstance(nxt, DkgError):
-        return _drain(channel, my, 5, PartyResult(my, error=nxt))
-    got4 = fetch(4)
-    complaints4 = [
-        FetchedComplaints4(
-            j, serde.decode_phase4(group, got4[j]) if got4.get(j) else None
-        )
-        for j in others
-    ]
+    with phase_span(trace, "net_round4", annotate_device=False):
+        nxt, b4 = nxt.proceed(fetched3)
+        _publish(channel, 4, my, serde.encode_phase4(group, b4) if b4 else None)
+        if isinstance(nxt, DkgError):
+            result.error = nxt
+            return finish(_drain(channel, my, 5, result))
+        got4 = fetch(4)
+        complaints4 = [
+            FetchedComplaints4(j, decoded(got4, j, serde.decode_phase4, _valid_phase4))
+            for j in others
+        ]
 
     # ---- round 5: adjudication + share disclosure ------------------------
-    nxt, b5 = nxt.proceed(complaints4)
-    _publish(channel, 5, my, serde.encode_phase5(group, b5) if b5 else None)
-    if isinstance(nxt, DkgError):
-        return PartyResult(my, error=nxt)
-    got5 = fetch(5)
-    fetched5 = [
-        FetchedPhase5(
-            j, serde.decode_phase5(group, got5[j]) if got5.get(j) else None
-        )
-        for j in others
-    ]
+    with phase_span(trace, "net_round5", annotate_device=False):
+        nxt, b5 = nxt.proceed(complaints4)
+        _publish(channel, 5, my, serde.encode_phase5(group, b5) if b5 else None)
+        if isinstance(nxt, DkgError):
+            result.error = nxt
+            return finish(result)
+        got5 = fetch(5)
+        fetched5 = [
+            FetchedPhase5(j, decoded(got5, j, serde.decode_phase5, _valid_phase5))
+            for j in others
+        ]
 
-    out, _ = nxt.finalise(fetched5)
+        out, _ = nxt.finalise(fetched5)
     if isinstance(out, DkgError):
-        return PartyResult(my, error=out)
-    master, share = out
-    return PartyResult(my, master=master, share=share)
+        result.error = out
+        return finish(result)
+    result.master, result.share = out
+    return finish(result)

@@ -4,7 +4,8 @@ Every DKG_TPU_* knob that silently mis-parsing could turn into a wrong
 (possibly OOM or wrong-kernel) compiled program goes through here, so
 the validate-and-raise behavior cannot drift between copies (knobs:
 DKG_TPU_DEAL_CHUNK / DKG_TPU_VERIFY_CHUNK / DKG_TPU_RLC_CHUNK via
-dkg.ceremony._env_chunk, DKG_TPU_ED_FUSED_DOUBLES via groups.device).
+dkg.ceremony._env_chunk, DKG_TPU_ED_FUSED_DOUBLES via groups.device,
+DKG_TPU_NET_* transport knobs via net.channel).
 """
 
 from __future__ import annotations
@@ -29,5 +30,49 @@ def nonneg_int(name: str, what: str) -> int | None:
     if v < 0:
         raise ValueError(
             f"{name}={env!r}: expected a non-negative integer ({what})"
+        )
+    return v
+
+
+def pos_int(name: str, what: str) -> int | None:
+    """None when ``name`` is unset, else its value as an int >= 1."""
+    env = os.environ.get(name)
+    if env is None:
+        return None
+    try:
+        v = int(env)
+    except ValueError:
+        v = 0
+    if v < 1:
+        raise ValueError(f"{name}={env!r}: expected a positive integer ({what})")
+    return v
+
+
+def pos_float(name: str, what: str) -> float | None:
+    """None when ``name`` is unset, else its value as a finite float > 0."""
+    env = os.environ.get(name)
+    if env is None:
+        return None
+    try:
+        v = float(env)
+    except ValueError:
+        v = -1.0
+    if not v > 0 or v != v or v == float("inf"):
+        raise ValueError(f"{name}={env!r}: expected a positive finite number ({what})")
+    return v
+
+
+def nonneg_float(name: str, what: str) -> float | None:
+    """None when ``name`` is unset, else its value as a finite float >= 0."""
+    env = os.environ.get(name)
+    if env is None:
+        return None
+    try:
+        v = float(env)
+    except ValueError:
+        v = -1.0
+    if not v >= 0 or v == float("inf"):
+        raise ValueError(
+            f"{name}={env!r}: expected a non-negative finite number ({what})"
         )
     return v

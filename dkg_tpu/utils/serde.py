@@ -178,7 +178,7 @@ def decode_phase1(group: HostGroup, data: bytes) -> Optional[bc.BroadcastPhase1]
         shares = tuple(_r_shares(r) for _ in range(r.u16()))
         r.done()
         return bc.BroadcastPhase1(coeffs, shares)
-    except Reader.Bad:
+    except (ValueError, struct.error):  # Reader.Bad is a ValueError
         return None
 
 
@@ -204,7 +204,7 @@ def decode_phase2(group: HostGroup, data: bytes) -> Optional[bc.BroadcastPhase2]
             ms.append(bc.MisbehavingPartiesRound1(idx, err, _r_proof(r)))
         r.done()
         return bc.BroadcastPhase2(tuple(ms))
-    except Reader.Bad:
+    except (ValueError, struct.error):  # Reader.Bad is a ValueError
         return None
 
 
@@ -222,7 +222,7 @@ def decode_phase3(group: HostGroup, data: bytes) -> Optional[bc.BroadcastPhase3]
         coeffs = tuple(r.point() for _ in range(r.u16()))
         r.done()
         return bc.BroadcastPhase3(coeffs)
-    except Reader.Bad:
+    except (ValueError, struct.error):  # Reader.Bad is a ValueError
         return None
 
 
@@ -245,7 +245,7 @@ def decode_phase4(group: HostGroup, data: bytes) -> Optional[bc.BroadcastPhase4]
         )
         r.done()
         return bc.BroadcastPhase4(ms)
-    except Reader.Bad:
+    except (ValueError, struct.error):  # Reader.Bad is a ValueError
         return None
 
 
@@ -267,7 +267,7 @@ def decode_phase5(group: HostGroup, data: bytes) -> Optional[bc.BroadcastPhase5]
         )
         r.done()
         return bc.BroadcastPhase5(ds)
-    except Reader.Bad:
+    except (ValueError, struct.error):  # Reader.Bad is a ValueError
         return None
 
 

@@ -4,6 +4,12 @@ Each party only talks to the broadcast hub (publish once per round,
 fetch everyone's round messages) — the deployment shape the reference
 delegates to "the blockchain" (src/lib.rs:91-92).  Swap the threads for
 processes/machines by pointing TcpHubChannel at the hub's address.
+
+The transport is hardened for flaky networks: RPCs retry with capped
+exponential backoff, the whole ceremony shares one fetch-deadline
+budget, and the hub keeps the first publish per (round, sender) while
+recording equivocation attempts as evidence (docs/fault_model.md; tune
+via DKG_TPU_NET_* or the TcpHubChannel keyword arguments below).
 Run: python examples/tcp_ceremony.py
 """
 
@@ -48,7 +54,10 @@ def main() -> None:
     results = [None] * n
 
     def party(i: int) -> None:
-        chan = TcpHubChannel(host, port)
+        # attempts/backoff ride out transient socket failures; budget_s
+        # caps the ceremony's total fetch waiting so silent parties cost
+        # one shared deadline, not one timeout per round
+        chan = TcpHubChannel(host, port, attempts=6, backoff_ms=100, budget_s=240.0)
         results[i] = run_party(
             chan, env, sorted_keys[i], pks, i + 1, random.SystemRandom(), timeout=60.0
         )

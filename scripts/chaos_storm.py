@@ -1,0 +1,190 @@
+"""Chaos soak driver: seeded random fault schedules -> CHAOS.json.
+
+Runs many full threaded ceremonies (dkg_tpu.net.run_party over an
+InProcessChannel or a TcpHub), each under a random-but-seeded
+FaultPlan, and asserts the resilience contract per ceremony: every
+honest (untouched) party finishes ``ok`` and all honest parties agree
+on the master public key.  A failing seed is a complete reproduction
+recipe — the plan is derived from the seed alone, so
+``tests/test_chaos.py`` can replay it exactly.
+
+Usage::
+
+    python scripts/chaos_storm.py --ceremonies 8 --n 6 --t 2 --out CHAOS.json
+    python scripts/chaos_storm.py --tcp          # exercise the TCP hub path
+
+Faulty parties are kept within the protocol's tolerance (at most t of
+the n members misbehave), so every run is *expected* to converge; a
+non-converging seed is a bug, not noise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from dkg_tpu.groups import host as gh  # noqa: E402
+from dkg_tpu.net import InProcessChannel, PartyResult, TcpHub, TcpHubChannel  # noqa: E402
+from dkg_tpu.net.faults import (  # noqa: E402
+    FaultPlan,
+    honest_results,
+    make_committee,
+    run_with_faults,
+)
+
+G = gh.RISTRETTO255
+
+# Wire-fault kinds the storm samples from (crash/delay are scheduled
+# separately so at most one party loses liveness per ceremony — more
+# than that turns every round into a full timeout wait).
+_BYTE_FAULTS = ("garbage", "truncate", "bitflip", "equivocate", "duplicate", "drop")
+
+
+def random_plan(seed: int, n: int, t: int, timeout: float) -> FaultPlan:
+    """Sample a fault schedule touching at most t of the n parties."""
+    rng = random.Random(seed)
+    plan = FaultPlan(seed)
+    faulty = rng.sample(range(1, n + 1), rng.randint(1, t))
+    liveness_used = False
+    for sender in faulty:
+        style = rng.random()
+        if style < 0.25 and not liveness_used:
+            liveness_used = True
+            if rng.random() < 0.5:
+                plan.crash_after(sender=sender, round_no=rng.randint(1, 4))
+            else:
+                plan.delay(rng.randint(1, 5), sender, seconds=timeout * 2.5)
+        else:
+            for _ in range(rng.randint(1, 2)):
+                kind = rng.choice(_BYTE_FAULTS)
+                getattr(plan, kind)(rng.randint(1, 5), sender)
+    return plan
+
+
+def run_one(seed: int, n: int, t: int, timeout: float, tcp: bool) -> dict:
+    env, keys, pks = make_committee(G, n, t, seed)
+    plan = random_plan(seed, n, t, timeout)
+    hub = None
+    try:
+        if tcp:
+            hub = TcpHub().start()
+            host, port = hub.address
+
+            def factory(i: int):
+                return TcpHubChannel(host, port)
+
+            evidence_channel = hub.channel
+        else:
+            chan = InProcessChannel()
+
+            def factory(i: int):
+                return chan
+
+            evidence_channel = chan
+
+        t0 = time.monotonic()
+        results = run_with_faults(env, keys, pks, plan, factory, timeout=timeout, seed=seed)
+        wall = time.monotonic() - t0
+        honest = honest_results(results, plan)
+        masters = {G.encode(r.master.point).hex() for r in honest if r.ok}
+        return {
+            "seed": seed,
+            "plan": plan.as_dict(),
+            "wall_s": round(wall, 3),
+            "outcomes": [
+                {"party": i + 1, "kind": type(r).__name__}
+                | (
+                    {
+                        "ok": r.ok,
+                        "error": str(r.error) if r.error else None,
+                        "quarantined": r.quarantined,
+                        "timeouts": r.timeouts,
+                        "retries": r.retries,
+                    }
+                    if isinstance(r, PartyResult)
+                    else {"detail": str(r)}
+                )
+                for i, r in enumerate(results)
+            ],
+            "honest_parties": [r.index for r in honest],
+            "honest_all_ok": bool(honest) and all(r.ok for r in honest),
+            "honest_agreed": len(masters) == 1,
+            "equivocations": [
+                {"round": rn, "sender": s, "distinct_payloads": len(p)}
+                for (rn, s), p in sorted(evidence_channel.equivocation_evidence().items())
+            ],
+        }
+    finally:
+        if hub is not None:
+            hub.stop()
+
+
+def run_storm(
+    ceremonies: int = 8,
+    n: int = 6,
+    t: int = 2,
+    base_seed: int = 0xC7A05,
+    timeout: float = 1.0,
+    tcp: bool = False,
+) -> dict:
+    runs = [run_one(base_seed + c, n, t, timeout, tcp) for c in range(ceremonies)]
+    survived = sum(r["honest_all_ok"] and r["honest_agreed"] for r in runs)
+    fault_counts: dict[str, int] = {}
+    for r in runs:
+        for f in r["plan"]["faults"]:
+            fault_counts[f["kind"]] = fault_counts.get(f["kind"], 0) + 1
+        fault_counts["crash"] = fault_counts.get("crash", 0) + len(r["plan"]["crash_after"])
+    return {
+        "ceremonies": ceremonies,
+        "n": n,
+        "t": t,
+        "base_seed": base_seed,
+        "timeout_s": timeout,
+        "transport": "tcp_hub" if tcp else "in_process",
+        "survived": survived,
+        "survival_rate": survived / ceremonies if ceremonies else None,
+        "faults_injected": dict(sorted(fault_counts.items())),
+        "runs": runs,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--ceremonies", type=int, default=8)
+    ap.add_argument("--n", type=int, default=6)
+    ap.add_argument("--t", type=int, default=2)
+    ap.add_argument("--seed", type=lambda v: int(v, 0), default=0xC7A05)
+    ap.add_argument("--timeout", type=float, default=1.0, help="per-round fetch timeout (s)")
+    ap.add_argument("--tcp", action="store_true", help="run over a TcpHub instead of in-process")
+    ap.add_argument("--out", default="CHAOS.json")
+    args = ap.parse_args()
+
+    report = run_storm(
+        ceremonies=args.ceremonies,
+        n=args.n,
+        t=args.t,
+        base_seed=args.seed,
+        timeout=args.timeout,
+        tcp=args.tcp,
+    )
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+    print(
+        f"chaos storm: {report['survived']}/{report['ceremonies']} ceremonies survived "
+        f"({report['transport']}); faults: {report['faults_injected']} -> {args.out}"
+    )
+    bad = [r["seed"] for r in report["runs"] if not (r["honest_all_ok"] and r["honest_agreed"])]
+    if bad:
+        print(f"NON-CONVERGING SEEDS (reproduce via FaultPlan(seed)): {bad}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

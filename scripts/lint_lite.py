@@ -14,6 +14,10 @@ linter), so the committed baseline stays clean between CI runs:
 * E722  bare ``except:``
 * B006  mutable default argument
 * F632  ``is`` comparison with a literal
+* DKG001  (dkg_tpu/net/ only) serde ``decode_phase*`` called outside the
+        ``_decode_quarantined`` quarantine — malformed peer bytes must
+        degrade to silent disqualification, never raise through the
+        party driver (docs/fault_model.md)
 
 Exit 0 = clean.  Run: ``python scripts/lint_lite.py`` (from repo root).
 Also executed by tests/test_import_hygiene.py so the default test tier
@@ -41,6 +45,11 @@ def _iter_files() -> list[pathlib.Path]:
     return out
 
 
+# Functions allowed to call serde.decode_phase* inside dkg_tpu/net/
+# (the DKG001 quarantine boundary, net/party.py).
+_DECODE_QUARANTINES = {"_decode_quarantined"}
+
+
 class _Checker(ast.NodeVisitor):
     def __init__(self, path: pathlib.Path, tree: ast.Module, source: str):
         self.path = path
@@ -49,6 +58,8 @@ class _Checker(ast.NodeVisitor):
         self.imports: list[tuple[int, str, str, bool]] = []  # line, local, code, reexport
         self.dunder_all: set[str] = set()
         self._source_lines = source.splitlines()
+        self._func_stack: list[str] = []
+        self._net_module = "dkg_tpu/net/" in path.as_posix()
         self._collect_all(tree)
         self.visit(tree)
 
@@ -146,10 +157,34 @@ class _Checker(ast.NodeVisitor):
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
         self._check_defaults(node)
+        self._func_stack.append(node.name)
         self.generic_visit(node)
+        self._func_stack.pop()
 
     def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
         self._check_defaults(node)
+        self._func_stack.append(node.name)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # DKG001: net-layer decodes must route through the quarantine —
+        # a raw decode_phase* call lets Byzantine bytes raise through
+        # run_party (malformed messages must disqualify the sender).
+        if self._net_module:
+            func = node.func
+            name = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else ""
+            )
+            if name.startswith("decode_phase") and not (
+                set(self._func_stack) & _DECODE_QUARANTINES
+            ):
+                self._add(
+                    node,
+                    "DKG001",
+                    f"{name}() outside _decode_quarantined — malformed peer "
+                    "bytes must quarantine, not raise",
+                )
         self.generic_visit(node)
 
     # -- finalize ------------------------------------------------------

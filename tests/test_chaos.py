@@ -1,0 +1,320 @@
+"""Chaos suite: full ceremonies under seeded, replayable fault schedules.
+
+Every test here drives real threaded n-party ceremonies through
+dkg_tpu.net.faults and asserts the GJKR resilience contract: all
+surviving honest parties return ``PartyResult.ok`` with byte-identical
+master public keys, no matter what Byzantine bytes, equivocations,
+crashes, or delays the faulty minority produces.  All schedules are
+deterministic in the seed, so a failure reproduces exactly.
+
+The soak storm (random schedules over many seeds) is additionally
+marked ``slow``; everything else is the fast tier-1 subset.
+"""
+
+import random
+
+import pytest
+
+from dkg_tpu.crypto.correct_decryption import CorrectHybridDecrKeyZkp
+from dkg_tpu.crypto.dleq import DleqZkp
+from dkg_tpu.crypto.elgamal import SymmetricKey
+from dkg_tpu.dkg import broadcast as bc
+from dkg_tpu.dkg.errors import DkgErrorKind
+from dkg_tpu.groups import host as gh
+from dkg_tpu.net import InProcessChannel, PartyResult
+from dkg_tpu.net.faults import (
+    CrashFault,
+    FaultPlan,
+    FaultyChannel,
+    honest_results,
+    make_committee,
+    run_with_faults,
+)
+from dkg_tpu.utils import serde
+
+pytestmark = pytest.mark.chaos
+
+G = gh.RISTRETTO255
+
+
+def _masters(results):
+    return {G.encode(r.master.point) for r in results if r.ok}
+
+
+def _run_plan(n, t, seed, plan, timeout=1.0):
+    env, keys, pks = make_committee(G, n, t, seed)
+    chan = InProcessChannel()
+    results = run_with_faults(env, keys, pks, plan, lambda i: chan, timeout=timeout, seed=seed)
+    return results, chan
+
+
+# ---------------------------------------------------------------------------
+# the acceptance scenario: garbage + equivocation + crash, twice
+# ---------------------------------------------------------------------------
+
+
+def _acceptance_run(seed):
+    plan = (
+        FaultPlan(seed)
+        .garbage(1, sender=2)  # Byzantine bytes in the dealing round
+        .equivocate(3, sender=5)  # two different round-3 messages
+        .crash_after(sender=7, round_no=2)  # completes round 2, then dies
+    )
+    results, chan = _run_plan(8, 2, seed, plan, timeout=1.8)
+    return plan, results, chan
+
+
+def test_chaos_ceremony_survives_garbage_equivocation_and_crash():
+    seed = 0xC7A05
+    plan, results, chan = _acceptance_run(seed)
+    honest = honest_results(results, plan)
+
+    # all >= 5 surviving honest parties are ok with one master key
+    assert len(honest) == 5
+    assert all(isinstance(r, PartyResult) and r.ok for r in honest)
+    assert len(_masters(honest)) == 1
+
+    # the crash propagated as a crash, not as a protocol error
+    assert isinstance(results[6], CrashFault)
+
+    # the garbage dealer was quarantined by every honest party, and the
+    # crashed party cost each of them the round-3..5 timeouts
+    assert all(r.quarantined >= 1 for r in honest)
+    assert all(r.timeouts == 3 for r in honest)
+
+    # the hub recorded the round-3 equivocation as evidence
+    ev = chan.equivocation_evidence()
+    assert (3, 5) in ev and len(ev[(3, 5)]) == 2
+
+    # same seed => same fault schedule => same outcome, byte-identical keys
+    plan2, results2, _ = _acceptance_run(seed)
+    assert plan2.as_dict() == plan.as_dict()
+    honest2 = honest_results(results2, plan2)
+    assert [r.index for r in honest2] == [r.index for r in honest]
+    assert _masters(honest2) == _masters(honest)
+
+
+# ---------------------------------------------------------------------------
+# satellite regression: malformed bytes in EVERY round quarantine the
+# sender instead of crashing honest parties
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("round_no", [1, 2, 3, 4, 5])
+@pytest.mark.parametrize("kind", ["garbage", "truncate"])
+def test_malformed_round_payload_quarantines_sender(round_no, kind):
+    seed = 1000 * round_no + (0 if kind == "garbage" else 1)
+    plan = FaultPlan(seed)
+    getattr(plan, kind)(round_no, sender=3)
+    results, _ = _run_plan(3, 1, seed, plan)
+    honest = honest_results(results, plan)
+    assert len(honest) == 2
+    assert all(isinstance(r, PartyResult) and r.ok for r in honest), [
+        (r.index, r.error) if isinstance(r, PartyResult) else r for r in results
+    ]
+    assert len(_masters(honest)) == 1
+
+
+def test_bitflipped_dealing_still_converges():
+    seed = 0xF11
+    plan = FaultPlan(seed).bitflip(1, sender=3)
+    results, _ = _run_plan(3, 1, seed, plan)
+    honest = honest_results(results, plan)
+    # a flipped bit either breaks decoding (quarantine) or corrupts a
+    # ciphertext/commitment (complaint path) — both must converge
+    assert all(r.ok for r in honest)
+    assert len(_masters(honest)) == 1
+
+
+# ---------------------------------------------------------------------------
+# handcrafted adversarial messages: decodable but poisoned indices
+# ---------------------------------------------------------------------------
+
+
+def _dummy_proof():
+    zkp = CorrectHybridDecrKeyZkp(DleqZkp(1, 1))
+    return bc.ProofOfMisbehaviour(
+        SymmetricKey(G.identity()), SymmetricKey(G.identity()), zkp, zkp
+    )
+
+
+def test_out_of_range_accusation_does_not_crash_honest_parties():
+    # accused_index=999 used to reach st.qualified[998] -> IndexError
+    evil2 = serde.encode_phase2(
+        G,
+        bc.BroadcastPhase2(
+            (bc.MisbehavingPartiesRound1(999, DkgErrorKind.SHARE_VALIDITY_FAILED, _dummy_proof()),)
+        ),
+    )
+    evil4 = serde.encode_phase4(G, bc.BroadcastPhase4((bc.MisbehavingPartiesRound3(999, 1, 1),)))
+    evil5 = serde.encode_phase5(G, bc.BroadcastPhase5((bc.DisclosedShare(999, 77, 1),)))
+    seed = 0xBAD1
+    plan = FaultPlan(seed).replace(2, 3, evil2).replace(4, 3, evil4).replace(5, 3, evil5)
+    results, _ = _run_plan(3, 1, seed, plan)
+    honest = honest_results(results, plan)
+    assert all(isinstance(r, PartyResult) and r.ok for r in honest), [
+        (r.index, r.error) if isinstance(r, PartyResult) else r for r in results
+    ]
+    assert len(_masters(honest)) == 1
+    # the poisoned messages were quarantined, not processed
+    assert all(r.quarantined >= 1 for r in honest)
+
+
+def test_dealing_addressed_to_wrong_recipients_is_quarantined():
+    # a dealing whose encrypted shares omit a recipient used to abort the
+    # *honest* party with FETCHED_INVALID_DATA; now the dealer is dropped
+    seed = 0xBAD2
+    env, keys, pks = make_committee(G, 3, 1, seed)
+    from dkg_tpu.dkg.committee import DistributedKeyGeneration
+
+    _, b1 = DistributedKeyGeneration.init(env, random.Random(3), keys[2], pks, 3)
+    import dataclasses
+
+    twisted = tuple(
+        dataclasses.replace(es, recipient_index=3) for es in b1.encrypted_shares
+    )
+    evil1 = serde.encode_phase1(G, bc.BroadcastPhase1(b1.committed_coefficients, twisted))
+    plan = FaultPlan(seed).replace(1, 3, evil1)
+    results, _ = _run_plan(3, 1, seed, plan)
+    honest = honest_results(results, plan)
+    assert all(isinstance(r, PartyResult) and r.ok for r in honest), [
+        (r.index, r.error) if isinstance(r, PartyResult) else r for r in results
+    ]
+    assert len(_masters(honest)) == 1
+    assert all(r.quarantined == 1 for r in honest)
+
+
+# ---------------------------------------------------------------------------
+# liveness faults
+# ---------------------------------------------------------------------------
+
+
+def test_delayed_dealing_degrades_to_dropout():
+    seed = 0xDE1A
+    plan = FaultPlan(seed).delay(1, sender=3, seconds=3.0)
+    results, _ = _run_plan(3, 1, seed, plan, timeout=0.8)
+    honest = honest_results(results, plan)
+    assert all(r.ok for r in honest)
+    assert len(_masters(honest)) == 1
+    assert all(r.timeouts >= 1 for r in honest)
+
+
+def test_crash_fault_raises_only_after_completed_round():
+    plan = FaultPlan(0).crash_after(sender=2, round_no=3)
+    chan = FaultyChannel(InProcessChannel(), plan, party=2)
+    chan.publish(3, 2, b"fine")  # round 3 still completes
+    assert chan.fetch(3, 1, timeout=0.1) == {2: b"fine"}
+    with pytest.raises(CrashFault):
+        chan.publish(4, 2, b"never sent")
+    with pytest.raises(CrashFault):
+        chan.fetch(4, 1, timeout=0.1)
+
+
+# ---------------------------------------------------------------------------
+# plan determinism + fault mechanics (no ceremony needed)
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_mutations_are_seed_deterministic():
+    a, b = FaultPlan(seed=42), FaultPlan(seed=42)
+    other = FaultPlan(seed=43)
+    assert a.garbage_bytes(1, 2, None) == b.garbage_bytes(1, 2, None)
+    assert a.garbage_bytes(1, 2, None) != other.garbage_bytes(1, 2, None)
+    payload = bytes(range(64))
+    assert a.flip_one_bit(3, 4, payload) == b.flip_one_bit(3, 4, payload)
+    assert a.truncate_bytes(2, 1, payload, None) == b.truncate_bytes(2, 1, payload, None)
+    # a flipped payload differs from the original in exactly one bit
+    flipped = a.flip_one_bit(3, 4, payload)
+    diff = sum(bin(x ^ y).count("1") for x, y in zip(payload, flipped))
+    assert diff == 1
+
+
+def test_duplicate_publish_fault_is_not_equivocation():
+    chan = InProcessChannel()
+    plan = FaultPlan(0).duplicate(1, sender=4)
+    FaultyChannel(chan, plan, party=4).publish(1, 4, b"same")
+    assert chan.fetch(1, 1, timeout=0.1) == {4: b"same"}
+    assert chan.equivocation_evidence() == {}
+
+
+def test_equivocate_fault_keeps_first_and_records_evidence():
+    chan = InProcessChannel()
+    plan = FaultPlan(7).equivocate(2, sender=4)
+    FaultyChannel(chan, plan, party=4).publish(2, 4, b"original")
+    assert chan.fetch(2, 1, timeout=0.1) == {4: b"original"}
+    ev = chan.equivocation_evidence()
+    assert list(ev) == [(2, 4)]
+    assert ev[(2, 4)][0] == b"original" and len(ev[(2, 4)]) == 2
+
+
+def test_fault_plan_as_dict_round_trips_to_json():
+    import json
+
+    plan = (
+        FaultPlan(9)
+        .garbage(1, 2)
+        .replace(2, 3, b"\x00\xff")
+        .crash_after(sender=5, round_no=4)
+    )
+    d = plan.as_dict()
+    assert json.loads(json.dumps(d)) == d
+    assert d["crash_after"] == {"5": 4}
+    kinds = {f["kind"] for f in d["faults"]}
+    assert kinds == {"garbage", "replace"}
+
+
+def test_counters_thread_into_ceremony_trace():
+    import threading
+
+    from dkg_tpu.utils.tracing import CeremonyTrace
+
+    seed = 0x7ACE
+    env, keys, pks = make_committee(G, 3, 1, seed)
+    plan = FaultPlan(seed).garbage(1, sender=3)
+    chan = InProcessChannel()
+    traces = [CeremonyTrace() for _ in range(3)]
+    results: list = [None] * 3
+
+    def worker(i):
+        from dkg_tpu.net import run_party
+
+        results[i] = run_party(
+            FaultyChannel(chan, plan, party=i + 1),
+            env,
+            keys[i],
+            pks,
+            i + 1,
+            random.Random(i),
+            timeout=1.0,
+            trace=traces[i],
+        )
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(3)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=60)
+
+    for i in (0, 1):  # honest parties
+        assert results[i].ok
+        tr = traces[i].as_dict()
+        assert set(tr["timings_s"]) == {f"net_round{r}" for r in range(1, 6)}
+        assert tr["counters"]["net.quarantined"] == 1
+        assert tr["meta"]["party_index"] == i + 1
+        assert results[i].trace is traces[i]
+
+
+# ---------------------------------------------------------------------------
+# the storm: random schedules over many seeds (nightly tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_chaos_storm_random_schedules():
+    from scripts.chaos_storm import run_storm
+
+    report = run_storm(ceremonies=4, n=5, t=2, base_seed=0x57AB, timeout=0.8)
+    assert report["ceremonies"] == 4
+    for entry in report["runs"]:
+        assert entry["honest_all_ok"], entry
+        assert entry["honest_agreed"], entry

@@ -2,15 +2,32 @@
 
 Host-only (no device kernels) — the multi-process transport analogue of
 the reference's hand-carried-arrays tests (committee.rs:1518-1656).
+Transport robustness (first-publish-wins, typed errors, retry/backoff,
+ceremony budget) is covered here; protocol-level fault injection lives
+in tests/test_chaos.py.
 """
 
+import io
 import random
+import socket
 import threading
+import time
+
+import pytest
 
 from dkg_tpu.dkg.committee import Environment
 from dkg_tpu.dkg.procedure_keys import MemberCommunicationKey, sort_committee
 from dkg_tpu.groups import host as gh
-from dkg_tpu.net import InProcessChannel, TcpHub, TcpHubChannel, run_party
+from dkg_tpu.net import (
+    InProcessChannel,
+    RetryBudgetExceeded,
+    TcpHub,
+    TcpHubChannel,
+    TransportError,
+    TruncatedStream,
+    run_party,
+)
+from dkg_tpu.net.channel import _read_exact
 from dkg_tpu.poly.host import lagrange_interpolation
 
 RNG = random.Random(0x4E7)
@@ -98,3 +115,191 @@ def test_dropout_party_does_not_block_others():
     assert all(r is not None and r.ok for r in results)
     assert G.eq(results[0].master.point, results[1].master.point)
     # the silent party is out of the qualified set on both survivors
+    # ... and the round timeouts are visible on the survivors' results
+    assert all(r.timeouts > 0 for r in results)
+
+
+# ---------------------------------------------------------------------------
+# transport robustness: typed errors, first-publish-wins, timeouts
+# ---------------------------------------------------------------------------
+
+
+def test_read_exact_raises_typed_transport_error():
+    with pytest.raises(TruncatedStream) as exc_info:
+        _read_exact(io.BytesIO(b"abc"), 8)
+    assert isinstance(exc_info.value, TransportError)
+    assert not isinstance(exc_info.value, EOFError)  # never a bare EOFError
+    assert _read_exact(io.BytesIO(b"abcd"), 4) == b"abcd"
+
+
+def test_first_publish_wins_records_equivocation():
+    chan = InProcessChannel()
+    chan.publish(1, 2, b"first")
+    chan.publish(1, 2, b"second")  # equivocation: kept as evidence only
+    chan.publish(1, 2, b"third")
+    assert chan.fetch(1, 1, timeout=0.1) == {2: b"first"}
+    ev = chan.equivocation_evidence()
+    assert ev == {(1, 2): (b"first", b"second", b"third")}
+
+
+def test_identical_republish_is_idempotent_not_equivocation():
+    chan = InProcessChannel()
+    chan.publish(1, 2, b"payload")
+    chan.publish(1, 2, b"payload")  # a retry, not an equivocation
+    assert chan.fetch(1, 1, timeout=0.1) == {2: b"payload"}
+    assert chan.equivocation_evidence() == {}
+
+
+def test_inprocess_fetch_returns_partial_round_on_deadline():
+    chan = InProcessChannel()
+    chan.publish(1, 1, b"a")
+    chan.publish(1, 2, b"b")
+    t0 = time.monotonic()
+    got = chan.fetch(1, expected=3, timeout=0.3)
+    elapsed = time.monotonic() - t0
+    assert got == {1: b"a", 2: b"b"}
+    assert 0.3 <= elapsed < 2.0  # waited the deadline out, then returned
+
+
+def test_inprocess_fetch_wakes_on_publish_not_busy_wait():
+    chan = InProcessChannel()
+    chan.publish(1, 1, b"a")
+    out = {}
+
+    def fetcher():
+        out["got"] = chan.fetch(1, expected=2, timeout=10.0)
+
+    th = threading.Thread(target=fetcher)
+    t0 = time.monotonic()
+    th.start()
+    time.sleep(0.15)
+    chan.publish(1, 2, b"b")
+    th.join(timeout=5)
+    elapsed = time.monotonic() - t0
+    assert out["got"] == {1: b"a", 2: b"b"}
+    assert elapsed < 5.0  # woke on notify, nowhere near the 10 s deadline
+
+
+def test_tcp_hub_concurrent_publish_fetch_8_threads():
+    n_workers = 8
+    hub = TcpHub().start()
+    try:
+        host, port = hub.address
+        results = [None] * n_workers
+        errors = []
+
+        def worker(i):
+            try:
+                chan = TcpHubChannel(host, port)
+                for round_no in (1, 2):
+                    chan.publish(round_no, i, b"w%d-r%d" % (i, round_no))
+                results[i] = {
+                    r: chan.fetch(r, expected=n_workers, timeout=10.0) for r in (1, 2)
+                }
+            except Exception as exc:  # noqa: BLE001 — surfaced via the assert
+                errors.append((i, exc))
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(n_workers)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=60)
+        assert not errors
+        for i, per_round in enumerate(results):
+            assert per_round is not None, f"worker {i} never finished"
+            for round_no in (1, 2):
+                assert per_round[round_no] == {
+                    j: b"w%d-r%d" % (j, round_no) for j in range(n_workers)
+                }
+    finally:
+        hub.stop()
+
+
+def test_tcp_hub_equivocation_visible_over_wire():
+    hub = TcpHub().start()
+    try:
+        host, port = hub.address
+        a, b = TcpHubChannel(host, port), TcpHubChannel(host, port)
+        a.publish(3, 5, b"one")
+        b.publish(3, 5, b"two")  # conflicting second publish
+        b.publish(3, 5, b"two")  # identical retry: not another attempt
+        assert a.fetch(3, 1, timeout=0.5) == {5: b"one"}
+        assert a.equivocation_counts() == {(3, 5): 2}
+    finally:
+        hub.stop()
+
+
+def test_tcp_channel_retries_through_transient_refusal():
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    box = {}
+
+    def start_hub_late():
+        time.sleep(0.4)
+        box["hub"] = TcpHub(port=port).start()
+
+    th = threading.Thread(target=start_hub_late)
+    th.start()
+    try:
+        chan = TcpHubChannel(
+            "127.0.0.1", port, attempts=30, backoff_ms=40, io_timeout_s=5.0,
+            rng=random.Random(1),
+        )
+        chan.publish(1, 1, b"made it")  # retried until the hub exists
+        th.join(timeout=10)
+        assert chan.stats["retries"] > 0
+        assert box["hub"].channel.fetch(1, 1, timeout=1.0) == {1: b"made it"}
+    finally:
+        th.join(timeout=10)
+        if "hub" in box:
+            box["hub"].stop()
+
+
+def test_tcp_channel_retry_budget_exhaustion_is_typed():
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()  # nothing listens here
+    chan = TcpHubChannel(
+        "127.0.0.1", port, attempts=2, backoff_ms=1, io_timeout_s=0.5,
+        rng=random.Random(2),
+    )
+    with pytest.raises(RetryBudgetExceeded):
+        chan.publish(1, 1, b"x")
+    assert chan.stats["retries"] == 1  # attempts - 1
+
+
+def test_tcp_channel_whole_ceremony_budget_clamps_fetches():
+    hub = TcpHub().start()
+    try:
+        host, port = hub.address
+        chan = TcpHubChannel(host, port, budget_s=0.6)
+        t0 = time.monotonic()
+        assert chan.fetch(1, expected=5, timeout=10.0) == {}
+        assert chan.fetch(2, expected=5, timeout=10.0) == {}
+        elapsed = time.monotonic() - t0
+        assert elapsed < 5.0  # both fetches shared the 0.6 s budget
+        assert chan.stats["budget_clamps"] == 2
+    finally:
+        hub.stop()
+
+
+def test_net_knobs_validated(monkeypatch):
+    monkeypatch.setenv("DKG_TPU_NET_ATTEMPTS", "zero")
+    with pytest.raises(ValueError, match="DKG_TPU_NET_ATTEMPTS"):
+        TcpHubChannel("127.0.0.1", 1)
+    monkeypatch.setenv("DKG_TPU_NET_ATTEMPTS", "0")
+    with pytest.raises(ValueError, match="DKG_TPU_NET_ATTEMPTS"):
+        TcpHubChannel("127.0.0.1", 1)
+    monkeypatch.delenv("DKG_TPU_NET_ATTEMPTS")
+    monkeypatch.setenv("DKG_TPU_NET_TIMEOUT_S", "-3")
+    with pytest.raises(ValueError, match="DKG_TPU_NET_TIMEOUT_S"):
+        TcpHubChannel("127.0.0.1", 1)
+    monkeypatch.delenv("DKG_TPU_NET_TIMEOUT_S")
+    monkeypatch.setenv("DKG_TPU_NET_BACKOFF_MS", "0")  # 0 backoff is legal
+    monkeypatch.setenv("DKG_TPU_NET_BUDGET_S", "90")
+    chan = TcpHubChannel("127.0.0.1", 1)
+    assert chan._backoff_s == 0.0
+    assert chan._budget_s == 90.0
