@@ -341,13 +341,20 @@ def _rung_child(curve: str, n: int, t: int) -> None:
     """One ladder rung, measured in a child process (flags arrive via
     the environment, set by the parent before spawning)."""
     _configure_cache()
-    t_deal, t_verify, t_rho = run(curve, n, t)
+    t_deal, t_verify, t_rho, table = run(curve, n, t)
     print(
         json.dumps(
             {
                 "deal_s": round(t_deal, 6),
                 "verify_s": round(t_verify, 6),
                 "fiat_shamir_s": round(t_rho, 6),
+                "table_s": round(table["seconds"], 6),
+                # warm == the fixed-base tables came from a cache (disk
+                # or process), i.e. zero from-scratch builds this run —
+                # the second-ceremony steady state the persistent table
+                # cache (groups/precompute.py) exists to reach.
+                "warm": table["stats"].get("builds", 0) == 0,
+                "table_stats": table["stats"],
                 "pallas": _pallas_active(),
             }
         )
@@ -391,7 +398,8 @@ def run(curve: str, n: int, t: int, rho_bits: int = 128):
         e, s, r, rho,
     )
     assert bool(jnp.all(ok)), "batch verification failed in bench"
-    return t_deal, t_verify, t_rho
+    table = {"seconds": c.table_seconds, "stats": dict(c.table_stats)}
+    return t_deal, t_verify, t_rho, table
 
 
 def _accelerator_usable(timeout_s: float = 300.0) -> bool:
@@ -595,6 +603,21 @@ def main():
         # to 0.0) must degrade to a huge-but-finite rate, not crash main()
         # before the always-emitted JSON line.
         rate = pairs / max(res["verify_s"], 1e-6)
+        # per-phase pair rates through the shared tracing helper, so the
+        # JSON speaks the same dialect as CeremonyTrace consumers; the
+        # one-off table acquisition gets its own key ("tables") instead
+        # of polluting the steady-state phases.
+        from dkg_tpu.utils.tracing import CeremonyTrace
+
+        phase_trace = CeremonyTrace(
+            timings_s={
+                "deal": res["deal_s"],
+                "verify": res["verify_s"],
+                "fiat_shamir": res["fiat_shamir_s"],
+                "tables": res.get("table_s") or 0.0,
+            }
+        )
+        rates = {k: round(v, 1) for k, v in phase_trace.rates(pairs).items()}
         # On TPU this is the real cross-device bit-exactness bit; on CPU
         # it still cross-checks the fused-kernel path against the
         # independent pure-XLA formulation.  Runs under the winning
@@ -643,6 +666,10 @@ def main():
                         "deal_s": res["deal_s"],
                         "verify_s": res["verify_s"],
                         "fiat_shamir_s": res["fiat_shamir_s"],
+                        "table_s": res.get("table_s"),
+                        "rates_per_s": rates,
+                        "warm": res.get("warm"),
+                        "table_stats": res.get("table_stats"),
                         "pallas": res["pallas"],
                         "flags": extra_env,  # {} == defaults
                         "tpu_cpu_bit_exact": parity,

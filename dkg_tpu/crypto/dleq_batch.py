@@ -64,24 +64,27 @@ def verify_batch(
 ) -> np.ndarray:
     """Batched verifier -> boolean array, one entry per proof.
 
-    Device work: a_i = b_i*z - h_i*e for every proof at once — the 4k
-    ladders collapse into one (2k, 2)-lane batched call (z·b stacked
-    with e·h), then one batched point subtraction.
+    Device work: a_i = b_i*z - h_i*e for every proof at once, as one
+    batched m=2 MSM per (proof, leg) lane — scalars (z, q-e) against
+    points (b_i, h_i), so the bucket/Straus kernel folds the negation
+    and the combining add into the multi-scalar sum itself instead of
+    two separate ladder calls plus a point subtraction.
     """
     if not proofs:
         return np.zeros((0,), dtype=bool)
     k = len(proofs)
     fs = group.scalar_field
+    q = fs.modulus
     bases = _pairs_to_device(cs, [s[0] for s in statements], [s[1] for s in statements])
     points = _pairs_to_device(cs, [s[2] for s in statements], [s[3] for s in statements])
     z_limbs = jnp.asarray(fh.encode(fs, [[p.response] * 2 for p in proofs]))
-    e_limbs = jnp.asarray(fh.encode(fs, [[p.challenge] * 2 for p in proofs]))
-    # one ladder over the stacked (2k, 2) batch: rows 0..k-1 are z·b,
-    # rows k..2k-1 are e·h
-    scalars = jnp.concatenate([z_limbs, e_limbs], axis=0)
-    pts = jnp.concatenate([bases, points], axis=0)
-    prod = gd.scalar_mul(cs, scalars, pts)
-    ann = gd.add(cs, prod[:k], gd.neg(cs, prod[k:]))
+    ne_limbs = jnp.asarray(
+        fh.encode(fs, [[(q - p.challenge) % q] * 2 for p in proofs])
+    )
+    # (k, 2 legs, m=2, ...): MSM axis -3 holds the (b, h) pair
+    scalars = jnp.stack([z_limbs, ne_limbs], axis=2)
+    pts = jnp.stack([bases, points], axis=2)
+    ann = gd.msm(cs, scalars, pts)
     ann_host = gd.to_host(cs, np.asarray(ann).reshape(-1, cs.ncoords, cs.field.limbs))
     ok = np.zeros((k,), dtype=bool)
     for i, (proof, (b1, b2, h1, h2)) in enumerate(zip(proofs, statements)):

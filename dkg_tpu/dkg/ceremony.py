@@ -291,66 +291,84 @@ def _point_rlc(cs, weights: jax.Array, points: jax.Array, nbits: int) -> jax.Arr
     weights (m, L) limb arrays with only the low nbits set;
     points (m, ..., C, L) -> (..., C, L).
 
-    Two schedules, same sum:
+    Three schedules, same sum:
 
+    * **Bucket Pippenger** (:func:`groups.device.msm_pippenger`) — no
+      per-point tables; points scatter into 2**c buckets per window,
+      c chosen from the batch shape.  Default off-TPU: it avoids the
+      per-lane Straus table build + gathers that dominate the CPU
+      lowering, and its three scan bodies keep compiles light.
     * **Windowed Straus (w = 4)** — per-point 16-entry tables, then
       ceil(nbits/4) rounds of (gather + tree-add + one 4-double window
       step), ~2.8x fewer point-adds than bit-at-a-time.  Default on
       TPU; the window step is the fused Pallas kernel when those are
       active, a plain XLA 4-double+add otherwise — so the conservative
       (no-Pallas) TPU configuration still gets the cheaper schedule.
-    * **Bit-at-a-time ladder** — default off-TPU: its scan body is
-      ~2.5x cheaper to COMPILE, which is what the CPU test tier is
-      bound by.
+    * **Bit-at-a-time ladder** — the compile-cheapest schedule, kept as
+      the cross-platform parity leg (bench parity_check).
 
-    ``DKG_TPU_RLC=straus|bits`` forces a schedule on any backend (the
-    cross-schedule parity tests use this).  Like every feature flag
-    here, it is read at TRACE time: a jitted caller (verify_batch)
-    caches its executable per static shape, so flipping the env var
-    after a same-shape call reuses the already-traced schedule —
-    set flags before the first call of a process (the bench's
-    child-per-rung design exists exactly for this).
+    ``DKG_TPU_RLC=straus|bits|pippenger`` (validated via envknobs)
+    forces a schedule on any backend (the cross-schedule parity tests
+    use this).  Like every feature flag here, it is read at TRACE time:
+    a jitted caller (verify_batch) caches its executable per static
+    shape, so flipping the env var after a same-shape call reuses the
+    already-traced schedule — set flags before the first call of a
+    process (the bench's child-per-rung design exists exactly for this).
     """
-    import os
+    from ..utils import envknobs
 
     m = points.shape[0]
-    mode = os.environ.get("DKG_TPU_RLC")
-    if mode not in (None, "straus", "bits"):
-        raise ValueError(
-            f"DKG_TPU_RLC={mode!r}: expected 'straus' or 'bits' "
-            "(a typo would silently measure the wrong schedule)"
-        )
-    fused = gd.fused_multi_active(cs)
-    use_straus = mode == "straus" or (
-        mode is None and (gd.fused_kernels_active() or fd._on_tpu())
+    mode = envknobs.choice(
+        "DKG_TPU_RLC",
+        ("straus", "bits", "pippenger"),
+        "a typo would silently measure the wrong schedule",
     )
-    if use_straus:
-        if points.ndim > 3:
-            # Chunk the first trailing batch axis so the per-point
-            # Straus tables stay under ~256 MB regardless of (m, t);
-            # any FURTHER batch axes multiply the per-chunk size too.
-            # The chunks MUST run through a sequential lax.map: the
-            # round-4 unrolled concatenate loop let the TPU buffer
-            # assigner overlap ~196 live 252 MB chunk tables at BLS
-            # n=16384 (MEMPROOF_TPU: 26.5 G fragmentation on 6 G of
-            # real temps).  DKG_TPU_RLC_CHUNK overrides the budget
-            # (tests force tiny chunks; 0 disables chunking).
+    fused = gd.fused_multi_active(cs)
+    if mode is None:
+        mode = (
+            "straus"
+            if gd.fused_kernels_active() or fd._on_tpu()
+            else "pippenger"
+        )
+    if mode != "bits" and points.ndim > 3:
+        # Chunk the first trailing batch axis so the per-chunk temps
+        # (per-point Straus tables / Pippenger buckets) stay under
+        # ~256 MB regardless of (m, t); any FURTHER batch axes multiply
+        # the per-chunk size too.  The chunks MUST run through a
+        # sequential lax.map: the round-4 unrolled concatenate loop let
+        # the TPU buffer assigner overlap ~196 live 252 MB chunk tables
+        # at BLS n=16384 (MEMPROOF_TPU: 26.5 G fragmentation on 6 G of
+        # real temps).  DKG_TPU_RLC_CHUNK overrides the budget
+        # (tests force tiny chunks; 0 disables chunking).
+        if mode == "straus":
             per_col = m * 16 * cs.ncoords * cs.field.limbs * 4
-            for extra in points.shape[2:-2]:
-                per_col *= extra
-            chunk = _env_chunk("DKG_TPU_RLC_CHUNK")
-            if chunk is None:
-                chunk = max(1, (256 << 20) // per_col)
-            ncols = points.shape[1]
-            if chunk and ncols > chunk:
-                from ..utils.scanchunk import map_chunked
+        else:
+            pwin = gd.pippenger_window(m)
+            nw = -(-nbits // pwin)
+            per_col = nw * (1 << pwin) * cs.ncoords * cs.field.limbs * 4
+        for extra in points.shape[2:-2]:
+            per_col *= extra
+        chunk = _env_chunk("DKG_TPU_RLC_CHUNK")
+        if chunk is None:
+            chunk = max(1, (256 << 20) // per_col)
+        ncols = points.shape[1]
+        if chunk and ncols > chunk:
+            from ..utils.scanchunk import map_chunked
 
-                def col_chunk(off, w):
-                    cols = lax.dynamic_slice_in_dim(points, off, w, axis=1)
-                    return _point_rlc(cs, weights, cols, nbits)
+            def col_chunk(off, w):
+                cols = lax.dynamic_slice_in_dim(points, off, w, axis=1)
+                return _point_rlc(cs, weights, cols, nbits)
 
-                return map_chunked(ncols, chunk, col_chunk)
+            return map_chunked(ncols, chunk, col_chunk)
 
+    if mode == "pippenger":
+        # weights broadcast over the column axes; the m axis moves last
+        # to match the MSM kernel's (..., m, C, L) convention
+        return gd.msm_pippenger(
+            cs, weights, jnp.moveaxis(points, 0, -3), nbits=nbits
+        )
+
+    if mode == "straus":
         window = gd.WINDOW
         nd = -(-nbits // window)  # windows that can be non-zero
         table = gd._build_table(cs, points)  # (m, ..., 16, C, L)
@@ -704,12 +722,28 @@ class BatchedCeremony:
     engine mirrors kernel-for-equation."""
 
     def __init__(self, curve: str, n: int, t: int, shared_string: bytes, rng):
+        import time as _time
+
+        from ..groups import precompute as gp
+
         self.cfg = CeremonyConfig(curve, n, t)
         cs = self.cfg.cs
         self.group = gh.ALL_GROUPS[curve]
         self.ck = CommitmentKey.generate(self.group, shared_string)
-        self.g_table = gd.fixed_base_table(cs, self.group.generator())
-        self.h_table = gd.fixed_base_table(cs, self.ck.h)
+        # g/h tables come from the persistent precompute cache: the
+        # second ceremony in a process (and, via the disk cache, the
+        # second process) pays zero table-build cost.  The stats delta
+        # is kept so run() can attribute table-build vs steady-state
+        # time in the trace (bench.py's `warm` flag reads it).
+        before = gp.stats()
+        t0 = _time.perf_counter()
+        self.g_table = gp.generator_table(cs)
+        self.h_table = gp.base_table(cs, self.ck.h)
+        self.table_seconds = _time.perf_counter() - t0
+        after = gp.stats()
+        self.table_stats = {
+            k: after[k] - before[k] for k in after if isinstance(after[k], int)
+        }
         self.rng = rng
         fs = cs.scalar
         self.coeffs_a = jnp.asarray(
@@ -753,6 +787,11 @@ class BatchedCeremony:
         from .errors import DkgError, DkgErrorKind
 
         cfg = self.cfg
+        if trace is not None:
+            # table acquisition happened in __init__; record it as its
+            # own phase so deal/verify numbers are steady-state
+            trace.record("tables", self.table_seconds)
+            trace.meta["table_cache"] = dict(self.table_stats)
         with phase_span(trace, "deal"):
             a, e, s, r = deal_chunked(
                 cfg, self.coeffs_a, self.coeffs_b, self.g_table, self.h_table

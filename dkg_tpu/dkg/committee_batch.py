@@ -28,6 +28,7 @@ import numpy as np
 from ..crypto.elgamal import SymmetricKey, open_pair_with_kems
 from ..fields import host as fh
 from ..groups import device as gd
+from ..groups import precompute
 from .committee import DkgPhase1, DkgPhase2, Environment, FetchedPhase1, _State
 from .hybrid_batch import broadcasts_from_batch, kem_batch, seal_shares
 from .broadcast import (
@@ -73,8 +74,8 @@ def batched_dealing(
     m = len(members)
 
     cfg = CeremonyConfig(group.name, n, t)
-    g_table = gd.fixed_base_table(cs, group.generator())
-    h_table = gd.fixed_base_table(cs, env.commitment_key.h)
+    g_table = precompute.generator_table(cs)
+    h_table = precompute.base_table(cs, env.commitment_key.h)
 
     # secret sampling stays host-side CSPRNG (SURVEY §7 hard part f)
     coeffs_a = jnp.asarray(

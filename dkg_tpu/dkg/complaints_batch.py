@@ -30,6 +30,7 @@ from ..crypto import dleq_batch
 from ..fields import host as fh
 from ..groups import device as gd
 from ..groups import host as gh
+from ..groups import precompute
 from .broadcast import BroadcastPhase1, MisbehavingPartiesRound1
 from .procedure_keys import MemberCommunicationPublicKey
 
@@ -79,8 +80,8 @@ def check_randomized_shares_limbs(
     THE single implementation of g*s + h*s' == sum_l idx^l E_l shared by
     complaint adjudication and the batched round-2
     (committee_batch.batched_share_verification)."""
-    g_tab = gd.fixed_base_table(cs, group.generator())
-    h_tab = gd.fixed_base_table(cs, ck.h)
+    g_tab = precompute.generator_table(cs)
+    h_tab = precompute.base_table(cs, ck.h)
     lhs = gd.add(
         cs,
         gd.fixed_base_mul(cs, g_tab, s_limbs),

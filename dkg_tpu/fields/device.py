@@ -151,11 +151,19 @@ def cond_sub(x: jax.Array, m) -> jax.Array:
 @functools.lru_cache(maxsize=None)
 def _antidiag_onehot(la: int, lb: int, shift: int) -> np.ndarray:
     """Constant one-hot tensor C[i,j,c] = 1 iff i+j+shift == c: collapses
-    the schoolbook product grid into columns with one tensordot."""
-    out = np.zeros((la, lb, la + lb), np.uint32)
+    the schoolbook product grid into columns with one tensordot.
+
+    float32, not uint32: XLA:CPU has no fast integer GEMM, so a uint32
+    tensordot lowers to a scalar loop (~6x slower measured at the
+    verify-round batch shape).  The contraction is still exact — every
+    operand is an integer < 2**16 and every partial column sum is an
+    integer < 2**22 (2L <= 48 terms of < 2**16), inside float32's 2**24
+    exact-integer range, so the result round-trips to uint32 bit-exactly
+    regardless of summation order."""
+    out = np.zeros((la, lb, la + lb), np.float32)
     for i in range(la):
         for j in range(lb):
-            out[i, j, i + j + shift] = 1
+            out[i, j, i + j + shift] = 1.0
     return out
 
 
@@ -171,12 +179,14 @@ def mul_wide(a: jax.Array, b: jax.Array) -> jax.Array:
       elementwise over the batch, so XLA fuses the chain and no
       (batch, La, Lb) product grid ever reaches HBM (7x faster than the
       tensordot form on v5e at large batches).
-    * elsewhere: outer product + one antidiagonal one-hot tensordot —
-      ~10x fewer primitives, which keeps CPU-test compile times sane.
+    * elsewhere: outer product + one antidiagonal one-hot tensordot,
+      lowered as a float32 GEMM (exact — see _antidiag_onehot): XLA:CPU
+      has no fast integer matmul, and the f32 form measures ~6x faster
+      at the verify-round batch shape while staying bit-identical.
 
-    Column sums stay < 2**21 for L<=24 (2L terms of < 2**16), safely
-    inside uint32 for the final carry scan.  This is the workhorse
-    under every field multiply.
+    Column sums stay < 2**22 for L<=24 (2L terms of < 2**16), safely
+    inside uint32 (and float32's exact-integer range) for the final
+    carry scan.  This is the workhorse under every field multiply.
     """
     a, b = _u32(a), _u32(b)
     la, lb = a.shape[-1], b.shape[-1]
@@ -192,11 +202,11 @@ def mul_wide(a: jax.Array, b: jax.Array) -> jax.Array:
             cols = row if cols is None else cols + row
         return normalize(cols, nc)
     prod = a[..., :, None] * b[..., None, :]
-    lo = prod & MASK16
-    hi = prod >> 16
+    lo = (prod & MASK16).astype(jnp.float32)
+    hi = (prod >> 16).astype(jnp.float32)
     cols = jnp.tensordot(lo, _antidiag_onehot(la, lb, 0), [[-2, -1], [0, 1]])
     cols = cols + jnp.tensordot(hi, _antidiag_onehot(la, lb, 1), [[-2, -1], [0, 1]])
-    return normalize(cols, nc)
+    return normalize(cols.astype(jnp.uint32), nc)
 
 
 # ---------------------------------------------------------------------------
@@ -223,6 +233,52 @@ def barrett_reduce(fs: FieldSpec, x: jax.Array) -> jax.Array:
     r = cond_sub(r, p_ext)
     r = cond_sub(r, p_ext)
     return r[..., :L]
+
+
+def fold_reduce(fs: FieldSpec, x: jax.Array) -> jax.Array:
+    """Pseudo-Mersenne reduction of a 2L-limb value to L limbs mod p.
+
+    Requires ``fs.fold_limbs`` (c = b**L mod p, lc <= 4 limbs; spec.py
+    guards admission).  Uses hi*b**L == hi*c (mod p) twice:
+
+    * fold 1: y1 = lo + hi*c       < b**L + b**(L+lc)   (L+lc+1 limbs)
+    * fold 2: y2 = lo' + hi'*c     < b**L + b**(2lc+1)  (L+1 limbs)
+    * y2 < 3p (spec guard), so two conditional subtractions finish.
+
+    Each fold is one L x lc mul_wide — far cheaper than Barrett's two
+    (L+1) x (L+1) multiplies — and the result is the same canonical
+    representative in [0, p), so swapping reducers is bit-exact.
+    """
+    L = fs.limbs
+    c = _u32(fs.fold_limbs)
+    lc = c.shape[-1]
+
+    def fold(lo, hi, out_len):
+        prod = mul_wide(hi, c)
+        w = max(prod.shape[-1], lo.shape[-1])
+
+        def pad_to(v):
+            return jnp.pad(v, [(0, 0)] * (v.ndim - 1) + [(0, w - v.shape[-1])])
+
+        # both operands are normalized limbs (< 2**16): columns < 2**17
+        return normalize(pad_to(prod) + pad_to(lo), out_len)
+
+    y1 = fold(x[..., :L], x[..., L:], L + lc + 1)
+    y2 = fold(y1[..., :L], y1[..., L:], L + 1)
+    p_ext = _u32(fs.p_limbs_ext)
+    y2 = cond_sub(y2, p_ext)
+    y2 = cond_sub(y2, p_ext)
+    return y2[..., :L]
+
+
+def reduce_wide(fs: FieldSpec, x: jax.Array) -> jax.Array:
+    """Reduce a normalized 2L-limb value to L limbs mod p, picking the
+    fold path when the field admits it and Barrett otherwise.  Both
+    produce the canonical representative, so the choice never changes
+    results — only the op count."""
+    if fs.fold_limbs is not None:
+        return fold_reduce(fs, x)
+    return barrett_reduce(fs, x)
 
 
 def zeros(fs: FieldSpec, batch: tuple = ()) -> jax.Array:
@@ -262,7 +318,7 @@ def neg(fs: FieldSpec, a: jax.Array) -> jax.Array:
 
 
 def mul(fs: FieldSpec, a: jax.Array, b: jax.Array) -> jax.Array:
-    return barrett_reduce(fs, mul_wide(a, b))
+    return reduce_wide(fs, mul_wide(a, b))
 
 
 def square(fs: FieldSpec, a: jax.Array) -> jax.Array:

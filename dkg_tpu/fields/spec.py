@@ -96,6 +96,28 @@ class FieldSpec:
         mu = (1 << (2 * LIMB_BITS * self.limbs)) // self.modulus
         return int_to_limbs(mu, self.limbs + 1)
 
+    @functools.cached_property
+    def fold_limbs(self) -> np.ndarray | None:
+        """Pseudo-Mersenne fold constant ``c = b**L mod p`` as limbs, or
+        ``None`` when the field is not fold-friendly.
+
+        When ``c`` is tiny (fits in <= 4 limbs, i.e. p = k*2**(16L) - c
+        for the curve base fields: 2**32 + 977 for secp256k1, 38 for
+        2**255 - 19), a 2L-limb product folds to L limbs with one
+        L x lc multiply instead of Barrett's two (L+1)-limb multiplies.
+        The guards mirror fields.device.fold_reduce's bound analysis:
+        after two folds the value is < b**L + b**(2*lc+1), which two
+        conditional subtractions bring below p iff that bound is <= 3p.
+        """
+        c = (1 << (LIMB_BITS * self.limbs)) % self.modulus
+        lc = max(1, (c.bit_length() + LIMB_BITS - 1) // LIMB_BITS)
+        if lc > 4 or 2 * lc + 1 > self.limbs:
+            return None
+        bound = (1 << (LIMB_BITS * self.limbs)) + (1 << (LIMB_BITS * (2 * lc + 1)))
+        if bound > 3 * self.modulus:
+            return None
+        return int_to_limbs(c, lc)
+
     def rand_int(self, rng) -> int:
         """Uniform field element from a host CSPRNG-style generator.
 

@@ -58,14 +58,13 @@ def fused_multi_active(cs: "CurveSpec") -> bool:
     DKG_TPU_FUSED_MULTI=1/0 forces either way (1 still requires the
     fused kernels to be active at all).
     """
-    import os
+    from ..utils import envknobs
 
-    env = os.environ.get("DKG_TPU_FUSED_MULTI")
-    if env not in (None, "0", "1"):
-        raise ValueError(
-            f"DKG_TPU_FUSED_MULTI={env!r}: expected '0' or '1' (a typo "
-            "would silently run the wrong kernel path)"
-        )
+    env = envknobs.choice(
+        "DKG_TPU_FUSED_MULTI",
+        ("0", "1"),
+        "a typo would silently run the wrong kernel path",
+    )
     if env == "0":
         return False
     if env == "1":
@@ -101,16 +100,15 @@ def fused_ladder_active(cs: "CurveSpec") -> bool:
     where the unrolled 4-double window body hangs Mosaic —
     scripts/ed_bisect.py measures exactly that.
     """
-    import os
+    from ..utils import envknobs
 
     if fused_multi_active(cs):
         return True
-    env = os.environ.get("DKG_TPU_ED_FUSED_LADDER")
-    if env not in (None, "0", "1"):
-        raise ValueError(
-            f"DKG_TPU_ED_FUSED_LADDER={env!r}: expected '0' or '1' (a "
-            "typo would silently run the wrong kernel path)"
-        )
+    env = envknobs.choice(
+        "DKG_TPU_ED_FUSED_LADDER",
+        ("0", "1"),
+        "a typo would silently run the wrong kernel path",
+    )
     return env == "1" and cs.kind == "edwards" and fused_kernels_active()
 
 
@@ -638,13 +636,20 @@ def fixed_base_table(cs: CurveSpec, base) -> jax.Array:
     add-bound, HBM is plentiful, and the build is one batched ladder
     call amortised over the whole ceremony).  Elsewhere the 8-bit
     host-built table.  DKG_TPU_FB_WINDOW=4/8/16 forces a width (any
-    non-host width builds on device).
+    non-host width builds on device; validated — a bare ``int(env)``
+    here used to raise an uncontextualised ValueError at trace time).
     """
-    import os
+    from ..utils import envknobs
 
-    env = os.environ.get("DKG_TPU_FB_WINDOW")
-    if env is not None:
-        window = int(env)
+    window = envknobs.pos_int(
+        "DKG_TPU_FB_WINDOW", "fixed-base window width in bits: 4, 8 or 16"
+    )
+    if window is not None:
+        if window not in (4, 8, 16):
+            raise ValueError(
+                f"DKG_TPU_FB_WINDOW={window}: expected a fixed-base "
+                "window width of 4, 8 or 16 bits"
+            )
         if window == FIXED_WINDOW:
             return jnp.asarray(_fixed_table_np(cs, base_key(cs, base)))
         return fixed_base_table_dev(cs, base, window)
@@ -672,7 +677,11 @@ def fixed_base_table_dev(cs: CurveSpec, base, window: int = 16) -> jax.Array:
 def _fixed_table_dev_cached(cs: CurveSpec, key: tuple, window: int) -> jax.Array:
     f = cs.field
     if window > 8:
-        return affine_canon(cs, _compose_table_dev(cs, key, window))
+        half = window // 2
+        if window % 2 or half > 8 or 16 % window:
+            raise ValueError(f"unsupported fixed-base window width {window}")
+        t_half = jnp.asarray(_fixed_table_np(cs, key, half))
+        return affine_canon(cs, _compose_table_dev(cs, t_half, window))
     host_group = gh.ALL_GROUPS[cs.name]
     base = base_key_to_point(cs, key)
     nw = _n_windows(cs, window)
@@ -695,23 +704,21 @@ def _fixed_table_dev_cached(cs: CurveSpec, key: tuple, window: int) -> jax.Array
     return affine_canon(cs, pts)
 
 
-def _compose_table_dev(cs: CurveSpec, key: tuple, window: int) -> jax.Array:
+def _compose_table_dev(cs: CurveSpec, t_half: jax.Array, window: int) -> jax.Array:
     """Wide-window table entries by COMPOSITION, not a device ladder.
 
     With the cheap host-built half-width table T[v][e] = e·(2**h)^v·B
-    (h = window/2), every wide entry d = lo + 2**h·hi is
-    ``T[2w][lo] + T[2w+1][hi]`` — ONE complete point add per entry.
-    The previous 16-step 1M-lane ladder build stalled the round-4 TPU
-    bench inside a single giant remote compile; this build is one small
-    host table + one batched add (+ the shared batched inversion), so
-    the device graphs stay compile-light.  Identity lanes flow through
-    the complete formulas (identity entries are stored projectively).
+    (h = window/2, shape (2·nw, 2**h, C, L), passed in so callers can
+    source it from the persistent cache — groups/precompute.py), every
+    wide entry d = lo + 2**h·hi is ``T[2w][lo] + T[2w+1][hi]`` — ONE
+    complete point add per entry.  The previous 16-step 1M-lane ladder
+    build stalled the round-4 TPU bench inside a single giant remote
+    compile; this build is one small host table + one batched add
+    (+ the shared batched inversion), so the device graphs stay
+    compile-light.  Identity lanes flow through the complete formulas
+    (identity entries are stored projectively).
     """
     f = cs.field
-    half = window // 2
-    if window % 2 or half > 8 or 16 % window:
-        raise ValueError(f"unsupported fixed-base window width {window}")
-    t_half = jnp.asarray(_fixed_table_np(cs, key, half))  # (2·nw, 2**half, C, L)
     lo = t_half[0::2][:, None, :, :, :]  # (nw, 1,  2**half, C, L)
     hi = t_half[1::2][:, :, None, :, :]  # (nw, 2**half, 1,  C, L)
     pts = add(cs, lo, hi)  # (nw, 2**half, 2**half, C, L); d = hi·2**half + lo
@@ -936,18 +943,49 @@ def _tree_reduce(cs: CurveSpec, pts: jax.Array, axis_len: int) -> jax.Array:
     return pts[..., 0, :, :]
 
 
-@_jit_static0
 def msm(cs: CurveSpec, scalars: jax.Array, points: jax.Array) -> jax.Array:
     """Batched MSM: Σ_j k_j·P_j over axis -2 of scalars / -3 of points.
 
     scalars (..., m, L), points (..., m, C, L) -> (..., C, L).
 
-    Straus with shared doublings: per 4-bit window, gather each point's
-    digit multiple from its table, tree-reduce the m contributions, then
-    4 shared doublings.  This is the share-verification workhorse
-    (reference seam: traits.rs:234-237; hot call committee.rs:292-296),
-    restructured from dalek's per-MSM Pippenger into one wide batched op.
+    Two bit-exact kernels (both end in the same complete formulas and a
+    canonical reduction order per window, so they agree limb-for-limb
+    after affine_canon):
+
+    * ``straus`` — shared-doubling Straus (:func:`msm_straus`): per-lane
+      16-entry tables, tree-reduce per window.  Default when the fused
+      multi-op Pallas kernels are active (TPU): the window step is one
+      kernel launch and the per-lane tables live in HBM.
+    * ``pippenger`` — bucket method (:func:`msm_pippenger`): no per-point
+      tables at all; points are scattered into 2**c buckets per window,
+      then each window is closed with ~2**(c+1) adds.  Default elsewhere:
+      on CPU the per-lane table build + gathers dominate Straus, and the
+      bucket width c scales with the batch (see :func:`pippenger_window`).
+
+    ``DKG_TPU_MSM=straus|pippenger`` (validated) forces a kernel.
+    This is the share-verification workhorse (reference seam:
+    traits.rs:234-237; hot call committee.rs:292-296).
     """
+    from ..utils import envknobs
+
+    mode = envknobs.choice(
+        "DKG_TPU_MSM",
+        ("straus", "pippenger"),
+        "MSM kernel: bucket method vs shared-doubling reference",
+    )
+    if mode is None:
+        mode = "straus" if fused_multi_active(cs) else "pippenger"
+    if mode == "pippenger":
+        return msm_pippenger(cs, scalars, points)
+    return msm_straus(cs, scalars, points)
+
+
+@_jit_static0
+def msm_straus(cs: CurveSpec, scalars: jax.Array, points: jax.Array) -> jax.Array:
+    """Straus shared-doubling MSM (the reference kernel — see :func:`msm`):
+    per 4-bit window, gather each point's digit multiple from its
+    per-lane table, tree-reduce the m contributions, then 4 shared
+    doublings."""
     m = points.shape[-3]
     tables = _build_table(cs, points)  # (..., m, 16, C, L)
     digits = scalar_windows(cs, scalars)  # (..., m, NW)
@@ -961,4 +999,98 @@ def msm(cs: CurveSpec, scalars: jax.Array, points: jax.Array) -> jax.Array:
 
     init = identity(cs, points.shape[:-3])
     acc, _ = lax.scan(step, init, digits_rev)
+    return acc
+
+
+def pippenger_window(m: int) -> int:
+    """Bucket width (bits) from the MSM batch shape.
+
+    Cost model (sequential point-op calls, the CPU/XLA currency):
+    NW(c) · (m + 2·(2**c - 1) + c + 1) with NW(c) = 256/c windows — the
+    scatter pass is m adds per window regardless of c, the bucket
+    suffix-sum closes at 2 adds per bucket, so doubling c halves the
+    window count once m dwarfs the 2**(c+1) closing cost.  Crossover
+    c=4 -> c=8 sits at m ≈ 2·(2**8 - 2**4) ≈ 450.  Widths must divide
+    the 16-bit limb (scalar_windows).
+    """
+    return 8 if m >= 448 else 4
+
+
+def msm_pippenger(
+    cs: CurveSpec, scalars: jax.Array, points: jax.Array, nbits: int | None = None
+) -> jax.Array:
+    """Bucket-method (Pippenger) MSM: scalars (..., m, L),
+    points (..., m, C, L) -> (..., C, L), summed over the m axis.
+
+    ``nbits`` bounds the scalars' bit width (e.g. 128-bit RLC weights);
+    windows above it are statically dropped.  Batch axes of scalars and
+    points must match (scalars broadcast up).
+    """
+    if nbits is None:
+        nbits = cs.scalar.limbs * 16
+    scalars = jnp.broadcast_to(scalars, points.shape[:-2] + scalars.shape[-1:])
+    return _msm_pippenger_core(cs, scalars, points, nbits)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def _msm_pippenger_core(
+    cs: CurveSpec, scalars: jax.Array, points: jax.Array, nbits: int
+) -> jax.Array:
+    """Three passes, all batched over the leading axes and all windows at
+    once (the m axis is the only sequential dimension that grows with
+    the problem):
+
+    1. scatter — scan over the m points; each step gathers the point's
+       current bucket per window (take_along_axis over the bucket axis),
+       adds through the complete formulas, and writes it back with a
+       branchless one-hot select.  Digit-0 contributions land in bucket
+       0, which the reduction ignores (identity-safe).
+    2. bucket close — descending suffix-sum scan over the 2**c - 1
+       non-zero buckets: run += B_b; tot += run computes
+       Σ_b b·B_b in 2 adds per bucket, for every window in parallel.
+    3. window combine — MSB-first Horner over the NW window sums via
+       :func:`window_step` (c doublings + 1 add per window).
+    """
+    m = points.shape[-3]
+    batch = points.shape[:-3]
+    window = pippenger_window(m)
+    entries = 1 << window
+    nw = min(_n_windows(cs, window), -(-nbits // window))
+    digits = scalar_windows(cs, scalars, window)[..., :nw]  # (..., m, nw)
+
+    pts_m = jnp.moveaxis(points, -3, 0)  # (m, ..., C, L)
+    digs_m = jnp.moveaxis(digits, -2, 0).astype(jnp.int32)  # (m, ..., nw)
+    bucket_ids = jnp.arange(entries, dtype=jnp.int32)
+
+    def scatter(buckets, args):
+        pt, dig = args  # (..., C, L), (..., nw)
+        idx = dig[..., None, None, None]  # (..., nw, 1, 1, 1)
+        cur = jnp.take_along_axis(buckets, idx, axis=-3)[..., 0, :, :]
+        new = add(cs, cur, pt[..., None, :, :])  # (..., nw, C, L)
+        onehot = bucket_ids == dig[..., None]  # (..., nw, entries)
+        buckets = jnp.where(onehot[..., None, None], new[..., None, :, :], buckets)
+        return buckets, None
+
+    init_b = identity(cs, batch + (nw, entries))
+    buckets, _ = lax.scan(scatter, init_b, (pts_m, digs_m))
+
+    # descending suffix sums over buckets [entries-1 .. 1]
+    nonzero = jnp.moveaxis(buckets[..., 1:, :, :], -3, 0)[::-1]
+
+    def close(carry, bucket):
+        run, tot = carry
+        run = add(cs, run, bucket)
+        tot = add(cs, tot, run)
+        return (run, tot), None
+
+    ident_w = identity(cs, batch + (nw,))
+    (_, win_sums), _ = lax.scan(close, (ident_w, ident_w), nonzero)
+
+    ws_rev = jnp.moveaxis(win_sums, -3, 0)[::-1]  # (nw, ..., C, L) MSB first
+    fused = fused_multi_active(cs)
+
+    def combine(acc, w_sum):
+        return window_step(cs, acc, w_sum, window, fused), None
+
+    acc, _ = lax.scan(combine, identity(cs, batch), ws_rev)
     return acc

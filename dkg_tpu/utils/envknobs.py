@@ -4,13 +4,34 @@ Every DKG_TPU_* knob that silently mis-parsing could turn into a wrong
 (possibly OOM or wrong-kernel) compiled program goes through here, so
 the validate-and-raise behavior cannot drift between copies (knobs:
 DKG_TPU_DEAL_CHUNK / DKG_TPU_VERIFY_CHUNK / DKG_TPU_RLC_CHUNK via
-dkg.ceremony._env_chunk, DKG_TPU_ED_FUSED_DOUBLES via groups.device,
+dkg.ceremony._env_chunk, DKG_TPU_RLC via dkg.ceremony._point_rlc,
+DKG_TPU_MSM / DKG_TPU_FB_WINDOW / DKG_TPU_FUSED_MULTI /
+DKG_TPU_ED_FUSED_LADDER / DKG_TPU_ED_FUSED_DOUBLES via groups.device,
 DKG_TPU_NET_* transport knobs via net.channel).
 """
 
 from __future__ import annotations
 
 import os
+
+
+def choice(name: str, options: tuple, what: str) -> str | None:
+    """None when ``name`` is unset, else its value validated against
+    ``options`` (a tuple of accepted strings).
+
+    Raises ValueError on anything else — enum knobs select compiled
+    kernel paths (MSM algorithm, RLC schedule, fused dispatch), where a
+    typo must fail loudly rather than silently run the default path.
+    """
+    env = os.environ.get(name)
+    if env is None:
+        return None
+    if env not in options:
+        raise ValueError(
+            f"{name}={env!r}: expected one of "
+            f"{', '.join(repr(o) for o in options)} ({what})"
+        )
+    return env
 
 
 def nonneg_int(name: str, what: str) -> int | None:

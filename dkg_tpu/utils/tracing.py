@@ -38,6 +38,14 @@ class CeremonyTrace:
     def total_s(self) -> float:
         return sum(self.timings_s.values())
 
+    def rates(self, units: float) -> dict:
+        """units/second for every recorded phase (zero-duration phases
+        omitted) — e.g. ``trace.rates(n * (n - 1))`` gives per-phase
+        pair-verify rates; one-off phases like ``tables`` (table-build,
+        recorded by BatchedCeremony) are naturally separated from the
+        steady-state ones by having their own key."""
+        return {ph: units / s for ph, s in self.timings_s.items() if s > 0}
+
     def as_dict(self) -> dict:
         return {
             "timings_s": dict(self.timings_s),

@@ -18,6 +18,12 @@ linter), so the committed baseline stays clean between CI runs:
         ``_decode_quarantined`` quarantine — malformed peer bytes must
         degrade to silent disqualification, never raise through the
         party driver (docs/fault_model.md)
+* DKG002  (dkg_tpu/dkg/ only) fixed-base table built in protocol code
+        (``fixed_base_table`` / ``fixed_base_table_dev`` /
+        ``_fixed_table_np``) — generator/Pedersen tables must come from
+        ``groups.precompute`` (``generator_table``/``base_table``) so
+        the persistent cache actually covers every hot path
+        (docs/perf.md)
 
 Exit 0 = clean.  Run: ``python scripts/lint_lite.py`` (from repo root).
 Also executed by tests/test_import_hygiene.py so the default test tier
@@ -49,6 +55,18 @@ def _iter_files() -> list[pathlib.Path]:
 # (the DKG001 quarantine boundary, net/party.py).
 _DECODE_QUARANTINES = {"_decode_quarantined"}
 
+# The fixed-base table builders protocol code (dkg_tpu/dkg/) must not
+# call directly (DKG002): going around groups/precompute.py rebuilds
+# generator/Pedersen tables from scratch every process and silently
+# forfeits the persistent cache.  Variable-point helpers (_build_table
+# on per-verify commitment points) are NOT in this set — only the
+# fixed-base family has a precomputed identity worth persisting.
+_FIXED_TABLE_BUILDERS = {
+    "fixed_base_table",
+    "fixed_base_table_dev",
+    "_fixed_table_np",
+}
+
 
 class _Checker(ast.NodeVisitor):
     def __init__(self, path: pathlib.Path, tree: ast.Module, source: str):
@@ -60,6 +78,7 @@ class _Checker(ast.NodeVisitor):
         self._source_lines = source.splitlines()
         self._func_stack: list[str] = []
         self._net_module = "dkg_tpu/net/" in path.as_posix()
+        self._dkg_module = "dkg_tpu/dkg/" in path.as_posix()
         self._collect_all(tree)
         self.visit(tree)
 
@@ -184,6 +203,21 @@ class _Checker(ast.NodeVisitor):
                     "DKG001",
                     f"{name}() outside _decode_quarantined — malformed peer "
                     "bytes must quarantine, not raise",
+                )
+        # DKG002: protocol code must take fixed-base tables from
+        # groups.precompute (persistent cache), never build them ad hoc.
+        if self._dkg_module:
+            func = node.func
+            name = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else ""
+            )
+            if name in _FIXED_TABLE_BUILDERS:
+                self._add(
+                    node,
+                    "DKG002",
+                    f"{name}() in dkg/ — use groups.precompute."
+                    "generator_table/base_table so fixed-base tables hit "
+                    "the persistent cache",
                 )
         self.generic_visit(node)
 

@@ -1,0 +1,100 @@
+"""Perf-regression gate over the committed bench history.
+
+The driver appends one ``BENCH_r{NN}.json`` per round; each carries the
+bench's single JSON line under ``parsed`` (bench.py docstring).  This
+script diffs the NEWEST TWO rounds' headline metric
+(``share_verify_pairs_per_sec_per_chip``) and FAILS (exit 1) when the
+newer rate dropped more than 20% below the older one — the tripwire
+that catches a perf_opt PR quietly un-doing a previous one.
+
+Deliberately forgiving about everything except a real regression:
+
+* fewer than two comparable rounds (missing files, ``parsed: null``
+  from a failed bench, zero/absent value) -> exit 0 with a note; an
+  infra-dead round must not block unrelated work;
+* different platforms (cpu vs tpu rounds) are incomparable -> exit 0
+  with a note, since a tunnel dying mid-history says nothing about the
+  code;
+* improvements and <=20% noise -> exit 0.
+
+Run: ``python scripts/perf_regress.py [--threshold 0.2] [dir]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import re
+import sys
+
+_PAT = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def _load_rounds(root: pathlib.Path) -> list[tuple[int, dict]]:
+    """(round number, parsed bench line) for every round with a usable
+    measurement, ascending."""
+    out: list[tuple[int, dict]] = []
+    for path in sorted(root.glob("BENCH_r*.json")):
+        m = _PAT.search(path.name)
+        if not m:
+            continue
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError):
+            continue
+        parsed = doc.get("parsed") if isinstance(doc, dict) else None
+        if not isinstance(parsed, dict):
+            continue
+        value = parsed.get("value")
+        if not isinstance(value, (int, float)) or value <= 0:
+            continue  # zeroed value == "all ladder rungs failed"
+        out.append((int(m.group(1)), parsed))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dir", nargs="?", default=None, help="history dir (default: repo root)")
+    ap.add_argument(
+        "--threshold",
+        type=float,
+        default=0.2,
+        help="fractional drop that fails the gate (default 0.2 == 20%%)",
+    )
+    args = ap.parse_args(argv)
+    root = (
+        pathlib.Path(args.dir)
+        if args.dir
+        else pathlib.Path(__file__).resolve().parent.parent
+    )
+
+    rounds = _load_rounds(root)
+    if len(rounds) < 2:
+        print(f"perf_regress: {len(rounds)} usable round(s) in {root} — nothing to diff")
+        return 0
+    (old_n, old), (new_n, new) = rounds[-2], rounds[-1]
+    old_plat = (old.get("config") or {}).get("platform")
+    new_plat = (new.get("config") or {}).get("platform")
+    if old_plat != new_plat:
+        print(
+            f"perf_regress: r{old_n} ({old_plat}) vs r{new_n} ({new_plat}) "
+            "ran on different platforms — incomparable, skipping"
+        )
+        return 0
+    old_v, new_v = float(old["value"]), float(new["value"])
+    change = (new_v - old_v) / old_v
+    line = (
+        f"perf_regress: r{old_n} {old_v:.1f} -> r{new_n} {new_v:.1f} "
+        f"{new.get('unit', '')} ({change:+.1%}) on {new_plat}"
+    )
+    if change < -args.threshold:
+        print(f"{line} — REGRESSION beyond {args.threshold:.0%}", file=sys.stderr)
+        return 1
+    print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

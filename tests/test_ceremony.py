@@ -351,6 +351,8 @@ def test_point_rlc_schedules_agree_exactly():
         d_bits = np.asarray(ce._point_rlc(cfg.cs, rho, e, 32))
         os.environ["DKG_TPU_RLC"] = "straus"
         d_straus = np.asarray(ce._point_rlc(cfg.cs, rho, e, 32))
+        os.environ["DKG_TPU_RLC"] = "pippenger"
+        d_pip = np.asarray(ce._point_rlc(cfg.cs, rho, e, 32))
     finally:
         for k, v in prev.items():
             if v is None:
@@ -359,8 +361,11 @@ def test_point_rlc_schedules_agree_exactly():
                 os.environ[k] = v
     g = c.group
     cs = cfg.cs
-    for col_bits, col_straus in zip(gd.to_host(cs, d_bits), gd.to_host(cs, d_straus)):
+    for col_bits, col_straus, col_pip in zip(
+        gd.to_host(cs, d_bits), gd.to_host(cs, d_straus), gd.to_host(cs, d_pip)
+    ):
         assert g.eq(col_bits, col_straus)
+        assert g.eq(col_bits, col_pip)
 
 
 @pytest.mark.slow
@@ -378,11 +383,14 @@ def test_deal_chunked_bit_identical_to_one_shot():
 
 
 @pytest.mark.slow
-def test_point_rlc_column_chunking_bit_identical(monkeypatch):
-    """The sequential-map column chunking of the Straus point-RLC
+@pytest.mark.parametrize("schedule", ["straus", "pippenger"])
+def test_point_rlc_column_chunking_bit_identical(monkeypatch, schedule):
+    """The sequential-map column chunking of the point-RLC
     (DKG_TPU_RLC_CHUNK; the MEMPROOF_TPU fragmentation fix) is
-    bit-identical to the unchunked schedule, ragged tail included."""
-    monkeypatch.setenv("DKG_TPU_RLC", "straus")
+    bit-identical to the unchunked schedule, ragged tail included —
+    for both chunkable schedules (straus and pippenger size their
+    chunks from different per-column memory estimates)."""
+    monkeypatch.setenv("DKG_TPU_RLC", schedule)
     cs = gd.ALL_CURVES["secp256k1"]
     g = gh.ALL_GROUPS["secp256k1"]
     rng = random.Random(0x51C)

@@ -25,6 +25,15 @@ def test_trace_records_phases_and_counters():
     json.loads(tr.json())  # serializable
 
 
+def test_trace_rates_per_phase():
+    tr = CeremonyTrace()
+    tr.record("deal", 2.0)
+    tr.record("verify", 0.5)
+    tr.record("tables", 0.0)  # zero-duration phases are omitted
+    rates = tr.rates(100)
+    assert rates == {"deal": 50.0, "verify": 200.0}
+
+
 @pytest.mark.slow  # a second full engine compile; nightly tier
 def test_ceremony_run_with_trace():
     rng = random.Random(1)
@@ -32,5 +41,10 @@ def test_ceremony_run_with_trace():
     tr = CeremonyTrace()
     out = c.run(rho_bits=64, trace=tr)
     assert bool(out["ok"].all())
-    assert set(tr.timings_s) == {"deal", "fiat_shamir", "verify", "finalise"}
+    assert set(tr.timings_s) == {
+        "tables", "deal", "fiat_shamir", "verify", "finalise"
+    }
+    assert set(tr.meta["table_cache"]) == {
+        "builds", "disk_loads", "disk_rejects", "proc_hits"
+    }
     assert tr.meta["n"] == 5 and tr.meta["curve"] == "ristretto255"
