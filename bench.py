@@ -12,6 +12,15 @@ vs_baseline: the reference publishes no numbers (BASELINE.md), so the
 ratio is against the driver-defined north star — a full n=4096 ceremony
 in < 10 s on a v5e-8, i.e. 4096^2/10/8 ≈ 209,715 pair-verifies/s/chip.
 value/209715 > 1 means the verification round is on budget.
+
+The dealing round's hybrid-encryption leg is measured alongside
+(``config.pairs_sealed_per_s``): all n*n (dealer, recipient) pairs
+sealed through the vectorized host DEM (dkg.hybrid_batch), with the
+per-pair scalar reference leg timed on the same KEM tensors — the
+resulting ``config.dem.speedup`` isolates the DEM the batch path
+replaces — and the chunk-overlapped KEM+DEM pipeline's wall time as
+``config.dem.pipeline_s`` (docs/perf.md "Dealing pipeline";
+scripts/perf_regress.py gates pairs_sealed_per_s too).
 """
 
 from __future__ import annotations
@@ -341,13 +350,19 @@ def _rung_child(curve: str, n: int, t: int) -> None:
     """One ladder rung, measured in a child process (flags arrive via
     the environment, set by the parent before spawning)."""
     _configure_cache()
-    t_deal, t_verify, t_rho, table = run(curve, n, t)
+    t_deal, t_verify, t_rho, table, seal = run(curve, n, t)
     print(
         json.dumps(
             {
                 "deal_s": round(t_deal, 6),
                 "verify_s": round(t_verify, 6),
                 "fiat_shamir_s": round(t_rho, 6),
+                "seal_s": round(seal["seal_s"], 6),
+                "seal_pairs": seal["pairs"],
+                "seal_scalar_s": round(seal["scalar_s"], 6),
+                "seal_scalar_pairs": seal["scalar_pairs"],
+                "dem_speedup": round(seal["speedup"], 2),
+                "seal_pipeline_s": round(seal["pipeline_s"], 6),
                 "table_s": round(table["seconds"], 6),
                 # warm == the fixed-base tables came from a cache (disk
                 # or process), i.e. zero from-scratch builds this run —
@@ -375,6 +390,72 @@ def _parity_child() -> None:
     print(json.dumps({"parity": parity_check()}))
 
 
+def _seal_rates(cfg, c, shares, hidings, rng, n: int) -> dict:
+    """Dealing DEM leg, measured where the vectorization lives: the host
+    DEM (point compression -> Blake2b KDF -> ChaCha20) of all n*n pairs,
+    batch vs per-pair scalar reference, BOTH on the same materialized
+    KEM tensors — so ``dem_speedup`` isolates the DEM and is not diluted
+    by the (unchanged) device KEM, which at the CPU rung costs ~100x the
+    batch DEM itself.  ``seal_s`` / ``pairs_sealed_per_s`` is the batch
+    DEM leg; the chunk-overlapped device-KEM+DEM pipeline's wall time is
+    recorded separately (``pipeline_s``).
+
+    The scalar leg runs over a dealer subset at large n (full at the CPU
+    rung shape) to bound its Python-loop cost.
+    """
+    import numpy as np
+
+    from dkg_tpu.dkg import hybrid_batch as hb
+    from dkg_tpu.fields import host as fh
+    from dkg_tpu.groups import device as gd
+    from dkg_tpu.groups import host as gh
+
+    g = gh.ALL_GROUPS[cfg.curve]
+    fs = cfg.cs.scalar
+    # recipient communication keys derived on device: one fixed-base
+    # batch mult instead of n host ladder walks
+    sks = jnp.asarray(fh.encode(fs, [fs.rand_int(rng) for _ in range(n)]))
+    pks_dev = gd.fixed_base_mul(cfg.cs, c.g_table, sks)
+    r_enc = jnp.asarray(
+        fh.encode(fs, [[fs.rand_int(rng) for _ in range(n)] for _ in range(n)])
+    )
+    shares_np = np.asarray(shares)
+    hidings_np = np.asarray(hidings)
+    # materialize the KEM tensors once; both DEM legs consume these
+    c1, kem = hb.kem_batch(cfg, pks_dev, r_enc, c.g_table)
+    c1, kem = np.asarray(c1), np.asarray(kem)
+    _, seal_s = timed(
+        lambda: hb.seal_shares_batch(g, cfg, shares_np, hidings_np, c1, kem)
+    )
+    # scalar reference leg: one pass (host Python, nothing to warm)
+    m_sc = min(n, max(1, 4096 // n))
+    t0 = time.perf_counter()
+    hb.seal_shares(
+        g, cfg, shares_np[:m_sc], hidings_np[:m_sc], c1[:m_sc], kem[:m_sc]
+    )
+    scalar_s = time.perf_counter() - t0
+    # full pipeline wall time (KEM kernels already compiled above, so a
+    # single pass is representative without a second ~n² KEM warmup)
+    t0 = time.perf_counter()
+    sync(
+        hb.seal_shares_pipeline(
+            g, cfg, shares_np, hidings_np, pks_dev, r_enc, c.g_table
+        )
+    )
+    pipeline_s = time.perf_counter() - t0
+    pairs, sc_pairs = n * n, m_sc * n
+    batch_rate = pairs / max(seal_s, 1e-9)
+    scalar_rate = sc_pairs / max(scalar_s, 1e-9)
+    return {
+        "seal_s": seal_s,
+        "pairs": pairs,
+        "scalar_s": scalar_s,
+        "scalar_pairs": sc_pairs,
+        "speedup": batch_rate / max(scalar_rate, 1e-9),
+        "pipeline_s": pipeline_s,
+    }
+
+
 def run(curve: str, n: int, t: int, rho_bits: int = 128):
     from dkg_tpu.dkg import ceremony as ce
 
@@ -387,6 +468,8 @@ def run(curve: str, n: int, t: int, rho_bits: int = 128):
         c.coeffs_a,
         c.coeffs_b,
     )
+    # dealing DEM leg: batch seal of all n*n pairs + scalar reference
+    seal = _seal_rates(cfg, c, s, r, rng, n)
     # sound Fiat-Shamir: rho from the full round-1 transcript digest
     t0 = time.perf_counter()
     rho = jnp.asarray(ce.derive_rho(cfg, a, e, s, r, rho_bits))
@@ -399,7 +482,7 @@ def run(curve: str, n: int, t: int, rho_bits: int = 128):
     )
     assert bool(jnp.all(ok)), "batch verification failed in bench"
     table = {"seconds": c.table_seconds, "stats": dict(c.table_stats)}
-    return t_deal, t_verify, t_rho, table
+    return t_deal, t_verify, t_rho, table, seal
 
 
 def _accelerator_usable(timeout_s: float = 300.0) -> bool:
@@ -614,10 +697,19 @@ def main():
                 "deal": res["deal_s"],
                 "verify": res["verify_s"],
                 "fiat_shamir": res["fiat_shamir_s"],
+                "seal": res.get("seal_s") or 0.0,
                 "tables": res.get("table_s") or 0.0,
             }
         )
         rates = {k: round(v, 1) for k, v in phase_trace.rates(pairs).items()}
+        # the dealing metric: n*n sealed pairs (every dealer seals to
+        # every recipient, self included) over the vectorized pipeline —
+        # its exact count, not the n*(n-1) verify-pair count rates()
+        # divides the other phases by
+        seal_rate = None
+        if res.get("seal_s"):
+            seal_rate = round(res["seal_pairs"] / max(res["seal_s"], 1e-9), 1)
+            rates["seal"] = seal_rate
         # On TPU this is the real cross-device bit-exactness bit; on CPU
         # it still cross-checks the fused-kernel path against the
         # independent pure-XLA formulation.  Runs under the winning
@@ -666,8 +758,16 @@ def main():
                         "deal_s": res["deal_s"],
                         "verify_s": res["verify_s"],
                         "fiat_shamir_s": res["fiat_shamir_s"],
+                        "seal_s": res.get("seal_s"),
                         "table_s": res.get("table_s"),
                         "rates_per_s": rates,
+                        "pairs_sealed_per_s": seal_rate,
+                        "dem": {
+                            "scalar_s": res.get("seal_scalar_s"),
+                            "scalar_pairs": res.get("seal_scalar_pairs"),
+                            "speedup": res.get("dem_speedup"),
+                            "pipeline_s": res.get("seal_pipeline_s"),
+                        },
                         "warm": res.get("warm"),
                         "table_stats": res.get("table_stats"),
                         "pallas": res["pallas"],

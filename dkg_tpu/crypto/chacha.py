@@ -2,10 +2,17 @@
 
 The DEM half of the hybrid encryption scheme (reference: elgamal.rs uses
 the `chacha20` crate, Cargo.toml:13).  Byte-stream ciphers are a poor TPU
-fit and sit off the hot path (SURVEY §7 step 4), so this stays host-side;
-share payloads are tiny (one scalar = 32 bytes).
+fit, so this stays host-side; share payloads are tiny (one scalar = 32
+bytes).  What IS batchable is the n² DEM tail of a whole dealing round:
+every sealed scalar fits one 64-byte keystream block, so the batched
+entry points below run the identical quarter-round schedule over an
+(N, 16)-u32 state array — one numpy dispatch per round instead of one
+per (dealer, recipient) pair (SURVEY §7 step 4; docs/perf.md "Dealing
+pipeline").
 
 Implemented from the RFC, with numpy for the 16-lane state arithmetic.
+The scalar and batched paths share ONE quarter-round definition
+(:func:`_quarter` indexes the trailing axis), so they cannot drift.
 """
 
 from __future__ import annotations
@@ -22,14 +29,29 @@ def _rotl(x: np.ndarray, n: int) -> np.ndarray:
 
 
 def _quarter(state: np.ndarray, a: int, b: int, c: int, d: int) -> None:
-    state[a] += state[b]
-    state[d] = _rotl(state[d] ^ state[a], 16)
-    state[c] += state[d]
-    state[b] = _rotl(state[b] ^ state[c], 12)
-    state[a] += state[b]
-    state[d] = _rotl(state[d] ^ state[a], 8)
-    state[c] += state[d]
-    state[b] = _rotl(state[b] ^ state[c], 7)
+    """One quarter round on ``state[..., 16]`` — shared by the scalar
+    path ((16,) states) and the batched path ((N, 16) states)."""
+    state[..., a] += state[..., b]
+    state[..., d] = _rotl(state[..., d] ^ state[..., a], 16)
+    state[..., c] += state[..., d]
+    state[..., b] = _rotl(state[..., b] ^ state[..., c], 12)
+    state[..., a] += state[..., b]
+    state[..., d] = _rotl(state[..., d] ^ state[..., a], 8)
+    state[..., c] += state[..., d]
+    state[..., b] = _rotl(state[..., b] ^ state[..., c], 7)
+
+
+def _double_rounds(working: np.ndarray) -> None:
+    """The 10 ChaCha20 double rounds, in place on ``(..., 16)`` u32."""
+    for _ in range(10):
+        _quarter(working, 0, 4, 8, 12)
+        _quarter(working, 1, 5, 9, 13)
+        _quarter(working, 2, 6, 10, 14)
+        _quarter(working, 3, 7, 11, 15)
+        _quarter(working, 0, 5, 10, 15)
+        _quarter(working, 1, 6, 11, 12)
+        _quarter(working, 2, 7, 8, 13)
+        _quarter(working, 3, 4, 9, 14)
 
 
 def _block(key_words: np.ndarray, counter: int, nonce_words: np.ndarray) -> bytes:
@@ -43,15 +65,7 @@ def _block(key_words: np.ndarray, counter: int, nonce_words: np.ndarray) -> byte
     )
     working = state.copy()
     with np.errstate(over="ignore"):
-        for _ in range(10):
-            _quarter(working, 0, 4, 8, 12)
-            _quarter(working, 1, 5, 9, 13)
-            _quarter(working, 2, 6, 10, 14)
-            _quarter(working, 3, 7, 11, 15)
-            _quarter(working, 0, 5, 10, 15)
-            _quarter(working, 1, 6, 11, 12)
-            _quarter(working, 2, 7, 8, 13)
-            _quarter(working, 3, 4, 9, 14)
+        _double_rounds(working)
         working += state
     return working.astype("<u4").tobytes()
 
@@ -70,3 +84,64 @@ def chacha20_xor(key: bytes, nonce: bytes, data: bytes, counter: int = 0) -> byt
         chunk = data[i : i + 64]
         out.extend(b ^ k for b, k in zip(chunk, ks))
     return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# batched keystreams — N independent (key, nonce) lanes at once
+# ---------------------------------------------------------------------------
+
+
+def chacha20_block_batch(
+    key_words: np.ndarray, counters: np.ndarray, nonce_words: np.ndarray
+) -> np.ndarray:
+    """One keystream block per lane: ``(N, 8)`` u32 keys, ``(N,)`` u32
+    counters, ``(N, 3)`` u32 nonces -> ``(N, 64)`` u8 keystream.
+
+    The whole batch is a single ``(N, 16)``-u32 state array run through
+    the shared :func:`_quarter` schedule — identical bits to N calls of
+    :func:`_block` (RFC 8439 vectors + equivalence in
+    tests/test_dem_batch.py).
+    """
+    n = key_words.shape[0]
+    state = np.empty((n, 16), dtype=np.uint32)
+    state[:, 0:4] = _CONSTANTS
+    state[:, 4:12] = key_words
+    state[:, 12] = counters
+    state[:, 13:16] = nonce_words
+    working = state.copy()
+    with np.errstate(over="ignore"):
+        _double_rounds(working)
+        working += state
+    return np.ascontiguousarray(working.astype("<u4")).view(np.uint8)
+
+
+def chacha20_xor_batch(
+    keys: np.ndarray, nonces: np.ndarray, data: np.ndarray, counter: int = 0
+) -> np.ndarray:
+    """Batched :func:`chacha20_xor`: each row of ``data`` (``(N, mlen)``
+    u8) is XORed with the keystream of its own ``(key, nonce)`` lane
+    (``(N, 32)`` / ``(N, 12)`` u8).  Rows are independent messages; all
+    share one length, the array shape.  Returns ``(N, mlen)`` u8.
+    """
+    keys = np.ascontiguousarray(keys, dtype=np.uint8)
+    nonces = np.ascontiguousarray(nonces, dtype=np.uint8)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    if keys.ndim != 2 or keys.shape[1] != 32:
+        raise ValueError("keys must be (N, 32) bytes")
+    if nonces.shape != (keys.shape[0], 12):
+        raise ValueError("nonces must be (N, 12) bytes (IETF variant)")
+    n, mlen = data.shape
+    if n != keys.shape[0]:
+        raise ValueError("data rows must match key lanes")
+    if mlen == 0:
+        return data.copy()
+    key_words = keys.view("<u4")
+    nonce_words = nonces.view("<u4")
+    blocks = [
+        chacha20_block_batch(
+            key_words, np.full(n, counter + b, dtype=np.uint32), nonce_words
+        )
+        for b in range((mlen + 63) // 64)
+    ]
+    ks = blocks[0] if len(blocks) == 1 else np.concatenate(blocks, axis=1)
+    return data ^ ks[:, :mlen]

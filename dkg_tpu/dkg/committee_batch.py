@@ -29,8 +29,9 @@ from ..crypto.elgamal import SymmetricKey, open_pair_with_kems
 from ..fields import host as fh
 from ..groups import device as gd
 from ..groups import precompute
+from ..utils.tracing import CeremonyTrace, phase_span
 from .committee import DkgPhase1, DkgPhase2, Environment, FetchedPhase1, _State
-from .hybrid_batch import broadcasts_from_batch, kem_batch, seal_shares
+from .hybrid_batch import broadcasts_from_batch, seal_shares_pipeline
 from .broadcast import (
     BroadcastPhase1,
     BroadcastPhase2,
@@ -51,6 +52,7 @@ def batched_dealing(
     rng,
     comm_keys: list[MemberCommunicationKey],
     members: list[int] | None = None,
+    trace: CeremonyTrace | None = None,
 ) -> list[tuple[DkgPhase1, BroadcastPhase1]]:
     """Round-1 dealing for the local parties ``members`` (1-based sorted
     indices; default: every committee member, the in-process-simulation
@@ -59,6 +61,9 @@ def batched_dealing(
 
     Returns one (phase1, broadcast) pair per local party, in ``members``
     order — drop-in for per-party ``DistributedKeyGeneration.init``.
+    ``trace`` records ``deal`` (engine polynomials + commitments) and
+    ``seal`` (KEM + DEM, with a ``pairs_sealed`` counter) separately so
+    traces show deal vs seal vs verify time.
     """
     group = env.group
     cs = gd.ALL_CURVES[group.name]
@@ -67,8 +72,8 @@ def batched_dealing(
     if len(comm_keys) != n:
         raise ValueError("committee size does not match environment")
     pks = sort_committee(group, [k.public() for k in comm_keys])
-    key_by_enc = {group.encode(k.public().point): k for k in comm_keys}
-    sorted_keys = [key_by_enc[group.encode(p.point)] for p in pks]
+    key_by_enc = {k.public().sort_key(group): k for k in comm_keys}
+    sorted_keys = [key_by_enc[p.sort_key(group)] for p in pks]
     if members is None:
         members = list(range(1, n + 1))
     m = len(members)
@@ -84,20 +89,23 @@ def batched_dealing(
     coeffs_b = jnp.asarray(
         fh.encode(fs, [[fs.rand_int(rng) for _ in range(t + 1)] for _ in range(m)])
     )
-    bare_dev, rand_dev, shares_dev, hidings_dev = deal_chunked(
-        cfg, coeffs_a, coeffs_b, g_table, h_table
-    )
+    with phase_span(trace, "deal"):
+        bare_dev, rand_dev, shares_dev, hidings_dev = deal_chunked(
+            cfg, coeffs_a, coeffs_b, g_table, h_table
+        )
 
-    # device KEM for all (dealer, recipient) pairs
+    # device KEM + DEM for all (dealer, recipient) pairs, chunk-
+    # pipelined so host sealing overlaps the next chunk's kernels
     pks_dev = gd.from_host(cs, [p.point for p in pks])
     r_enc = jnp.asarray(
         fh.encode(fs, [[fs.rand_int(rng) for _ in range(n)] for _ in range(m)])
     )
-    c1, kem = kem_batch(cfg, pks_dev, r_enc, g_table)
-    sealed = seal_shares(
-        group, cfg, np.asarray(shares_dev), np.asarray(hidings_dev),
-        np.asarray(c1), np.asarray(kem),
-    )
+    with phase_span(trace, "seal"):
+        sealed = seal_shares_pipeline(
+            group, cfg, shares_dev, hidings_dev, pks_dev, r_enc, g_table
+        )
+        if trace is not None:
+            trace.bump("pairs_sealed", m * n)
     broadcasts = broadcasts_from_batch(group, cfg, np.asarray(rand_dev), sealed)
 
     shares_host = fh.decode(fs, np.asarray(shares_dev))

@@ -9,9 +9,17 @@ batched device kernels:
     c1[d, i]  = g·r[d, i]          (fixed-base table)
     kem[d, i] = pk_i · r[d, i]     (batched variable-base)
 
-and only the byte-level tail (point compression -> Blake2b KDF ->
-ChaCha20) stays host-side, using the native C++ runtime when available
-(SURVEY §7 step 4: DEM off the hot path).
+and the byte-level DEM tail (point compression -> Blake2b KDF ->
+ChaCha20) is array-shaped too (:func:`seal_shares_batch`): one batched
+affine-encode per ceremony (``groups.device.encode_batch``), one
+``(N, 16)``-u64 Blake2b compression batch (``crypto.blake2``) and one
+``(2·N, 16)``-u32 ChaCha20 state batch (``crypto.chacha``) replace the
+per-pair Python loop.  :func:`seal_shares` survives as the scalar
+reference leg — ``DKG_TPU_DEM=scalar|batch`` selects, and both legs
+produce bit-identical wire bytes (tests/test_dem_batch.py).
+:func:`seal_shares_pipeline` chunks deal->KEM->DEM so the host DEM of
+chunk k overlaps the device dispatch of chunk k+1
+(docs/perf.md "Dealing pipeline").
 """
 
 from __future__ import annotations
@@ -93,6 +101,152 @@ def seal_shares(
     return out
 
 
+def dem_mode() -> str:
+    """Which DEM leg seals dealing rounds: ``DKG_TPU_DEM=scalar|batch``
+    (validated), default ``batch``.  ``scalar`` is the per-pair
+    reference leg the batched path is byte-equivalence-tested against."""
+    from ..utils import envknobs
+
+    return (
+        envknobs.choice(
+            "DKG_TPU_DEM",
+            ("scalar", "batch"),
+            "DEM sealing path; 'scalar' is the per-pair reference leg",
+        )
+        or "batch"
+    )
+
+
+def _le_bytes(arr: np.ndarray, nbytes: int) -> np.ndarray:
+    """16-bit limb rows ``(N, L)`` -> little-endian byte rows
+    ``(N, nbytes)`` (the scalar wire encoding), fully vectorized."""
+    le = np.ascontiguousarray(arr.astype("<u2")).view(np.uint8)
+    return le[:, :nbytes]
+
+
+def _host_points(cs, pts: np.ndarray) -> list:
+    """Point limb batch ``(N, C, L)`` -> host point tuples (same ints as
+    ``gd.to_host``), via one vectorized limbs->bytes pass instead of the
+    per-limb Python loop."""
+    le = np.ascontiguousarray(pts.astype("<u2")).view(np.uint8)
+    return [
+        tuple(
+            int.from_bytes(le[i, c].tobytes(), "little")
+            for c in range(cs.ncoords)
+        )
+        for i in range(pts.shape[0])
+    ]
+
+
+def seal_shares_batch(
+    group: gh.HostGroup,
+    cfg,
+    shares: np.ndarray,  # (n_dealers, n_recipients, L) scalar limbs
+    hidings: np.ndarray,
+    c1: np.ndarray,  # (n_dealers, n_recipients, C, L) from kem_batch
+    kem: np.ndarray,
+) -> list[list[tuple[HybridCiphertext, HybridCiphertext]]]:
+    """Array-shaped :func:`seal_shares`: same sealed pairs, bit-identical
+    ciphertext and e1 wire bytes, computed by batch entry points —
+    ``gd.encode_batch`` (one Montgomery-trick inversion + one transfer
+    for every KEM point), ``crypto.blake2.kdf_batch`` (one u64 Blake2b
+    compression batch per tag) and ``crypto.chacha.chacha20_xor_batch``
+    (every sealed scalar fits one keystream block, so the whole round is
+    a single (2·n², 16)-u32 state batch).
+
+    The returned ``e1`` tuples are the same projective tuples the scalar
+    leg emits (``gd.to_host`` of the KEM kernel output) — only the KEM
+    points need canonicalisation (their *encoding* keys the KDF), so the
+    e1 leg skips the inversion entirely.
+    """
+    from ..crypto.blake2 import kdf_batch
+    from ..crypto.chacha import chacha20_xor_batch
+
+    cs = cfg.cs
+    fs = cs.scalar
+    n_d, n_r = shares.shape[:2]
+    n_pairs = n_d * n_r
+    shape = (n_pairs, cs.ncoords, cs.field.limbs)
+    kem_enc = gd.encode_batch(cs, kem).reshape(n_pairs, -1)
+    e1s = _host_points(cs, np.asarray(c1).reshape(shape))
+    msg_s = _le_bytes(shares.reshape(n_pairs, -1), fs.nbytes)
+    msg_h = _le_bytes(hidings.reshape(n_pairs, -1), fs.nbytes)
+    k1, nonce1 = kdf_batch(kem_enc, PERSON_SHARE)
+    k2, nonce2 = kdf_batch(kem_enc, PERSON_RAND)
+    ct_s = chacha20_xor_batch(k1, nonce1, msg_s)
+    ct_h = chacha20_xor_batch(k2, nonce2, msg_h)
+    out = []
+    for d in range(n_d):
+        row = []
+        for i in range(n_r):
+            j = d * n_r + i
+            row.append(
+                (
+                    HybridCiphertext(e1s[j], ct_s[j].tobytes()),
+                    HybridCiphertext(e1s[j], ct_h[j].tobytes()),
+                )
+            )
+        out.append(row)
+    return out
+
+
+def seal_shares_pipeline(
+    group: gh.HostGroup,
+    cfg,
+    shares,  # (n_dealers, n_recipients, L) limbs, device or host
+    hidings,
+    pks_dev: jnp.ndarray,
+    r_enc: jnp.ndarray,  # (n_dealers, n_recipients, L) encryption randomness
+    g_table: jnp.ndarray,
+    chunk: int | None = None,
+) -> list[list[tuple[HybridCiphertext, HybridCiphertext]]]:
+    """KEM + DEM for a whole dealing round, chunked over dealers so the
+    host DEM of chunk k overlaps the device dispatch of chunk k+1 (JAX
+    dispatch is asynchronous; the DEM's single transfer per chunk is
+    what blocks, and only on its own chunk's kernels).
+
+    ``DKG_TPU_DEM_CHUNK`` pins dealers per chunk (0 disables chunking);
+    the default targets ~4096 pairs per chunk.  The DEM leg follows
+    ``DKG_TPU_DEM`` (:func:`dem_mode`).  Output is bit-identical to an
+    unchunked ``kem_batch`` + seal: chunks are independent dealer rows.
+    """
+    from ..utils import envknobs
+
+    n_d, n_r = r_enc.shape[0], r_enc.shape[1]
+    if chunk is None:
+        chunk = envknobs.nonneg_int(
+            "DKG_TPU_DEM_CHUNK", "dealers per DEM chunk; 0 disables chunking"
+        )
+        if chunk is None:
+            chunk = max(1, 4096 // max(1, n_r))
+    seal = seal_shares if dem_mode() == "scalar" else seal_shares_batch
+    shares = np.asarray(shares)
+    hidings = np.asarray(hidings)
+    if not chunk or chunk >= n_d:
+        c1, kem = kem_batch(cfg, pks_dev, r_enc, g_table)
+        return seal(group, cfg, shares, hidings, np.asarray(c1), np.asarray(kem))
+    spans = [(a, min(a + chunk, n_d)) for a in range(0, n_d, chunk)]
+    nxt = kem_batch(cfg, pks_dev, r_enc[spans[0][0] : spans[0][1]], g_table)
+    out: list[list[tuple[HybridCiphertext, HybridCiphertext]]] = []
+    for k, (a, b) in enumerate(spans):
+        cur = nxt
+        # dispatch chunk k+1 BEFORE blocking on chunk k's transfer
+        nxt = (
+            kem_batch(
+                cfg, pks_dev, r_enc[spans[k + 1][0] : spans[k + 1][1]], g_table
+            )
+            if k + 1 < len(spans)
+            else None
+        )
+        out.extend(
+            seal(
+                group, cfg, shares[a:b], hidings[a:b],
+                np.asarray(cur[0]), np.asarray(cur[1]),
+            )
+        )
+    return out
+
+
 def open_share(
     group: gh.HostGroup,
     sk: int,
@@ -110,6 +264,49 @@ def open_share(
         v = int.from_bytes(pt, "little") if len(pt) == fs.nbytes else None
         out.append(v if v is None or v < fs.modulus else None)
     return out[0], out[1]
+
+
+def open_shares_batch(
+    group: gh.HostGroup,
+    cfg,
+    sk: int,
+    pairs: list[tuple[HybridCiphertext, HybridCiphertext]],
+) -> list[tuple[int | None, int | None]]:
+    """Recipient-side :func:`open_share` for all dealers' pairs at once:
+    the KEM recoveries ``sk·e1`` run as ONE batched device scalar-mult,
+    point compression as one ``gd.encode_batch``, and the KDF/ChaCha
+    tail as one batch per tag.  Element semantics match
+    :func:`open_share` exactly (shared-KEM pair layout: ``share_ct.e1``
+    keys both tags; wrong-length or out-of-range payloads -> None).
+    """
+    from ..crypto.blake2 import kdf_batch
+    from ..crypto.chacha import chacha20_xor_batch
+
+    cs = cfg.cs
+    fs = group.scalar_field
+    n = len(pairs)
+    if n == 0:
+        return []
+    sk_limbs = jnp.asarray(fh.encode(fs, [sk] * n))
+    kem_dev = gd.scalar_mul(
+        cs, sk_limbs, gd.from_host(cs, [p[0].e1 for p in pairs])
+    )
+    kem_enc = gd.encode_batch(cs, np.asarray(kem_dev))
+    vals: list[list[int | None]] = [[None, None] for _ in range(n)]
+    for col, tag in ((0, PERSON_SHARE), (1, PERSON_RAND)):
+        cts = [p[col].ciphertext for p in pairs]
+        rows = [i for i, ct in enumerate(cts) if len(ct) == fs.nbytes]
+        if not rows:
+            continue
+        data = np.frombuffer(
+            b"".join(cts[i] for i in rows), dtype=np.uint8
+        ).reshape(len(rows), fs.nbytes)
+        key, nonce = kdf_batch(kem_enc[rows], tag)
+        pt = chacha20_xor_batch(key, nonce, data)
+        for r, i in enumerate(rows):
+            v = int.from_bytes(pt[r].tobytes(), "little")
+            vals[i][col] = v if v < fs.modulus else None
+    return [(a, b) for a, b in vals]
 
 
 def broadcasts_from_batch(

@@ -28,7 +28,6 @@ Overflow discipline (the invariants that make this correct):
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -55,12 +54,13 @@ def _on_tpu() -> bool:
     TRACE time — without the override they would compile a program the
     chip never runs.
     """
-    env = os.environ.get("DKG_TPU_ASSUME_BACKEND")
-    if env:  # empty string == the shell idiom for unset
-        if env not in ("tpu", "cpu"):
-            raise ValueError(
-                f"DKG_TPU_ASSUME_BACKEND={env!r}: expected 'tpu' or 'cpu'"
-            )
+    from ..utils import envknobs
+
+    env = envknobs.choice(
+        "DKG_TPU_ASSUME_BACKEND", ("tpu", "cpu"),
+        "backend the trace-time dispatches assume (AOT compiles)",
+    )
+    if env is not None:
         return env == "tpu"
     global _backend_cache
     if _backend_cache is None:
@@ -79,11 +79,13 @@ def fused_kernels_active() -> bool:
     DKG_TPU_PALLAS=1/0 forces either way.  Resolved lazily at trace
     time so importing this module never initialises a JAX backend (see
     parallel/hostmesh.py ordering)."""
-    env = os.environ.get("DKG_TPU_PALLAS")
-    if env == "1":
-        return True
-    if env == "0":
-        return False
+    from ..utils import envknobs
+
+    env = envknobs.choice(
+        "DKG_TPU_PALLAS", ("0", "1"), "fused Pallas kernel dispatch"
+    )
+    if env is not None:
+        return env == "1"
     return _on_tpu()
 
 

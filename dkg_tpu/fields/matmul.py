@@ -36,7 +36,6 @@ tests/test_field_matmul.py).
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -68,11 +67,13 @@ def mxu_matmul_active() -> bool:
     the int8 dot is exact on every backend, the MXU is just where it
     pays).  Resolved lazily at trace time, like fused_kernels_active().
     """
-    env = os.environ.get("DKG_TPU_MXU")
-    if env == "1":
-        return True
-    if env == "0":
-        return False
+    from ..utils import envknobs
+
+    env = envknobs.choice(
+        "DKG_TPU_MXU", ("0", "1"), "MXU int8 matmul dispatch; default follows backend"
+    )
+    if env is not None:
+        return env == "1"
     return fd._on_tpu()
 
 

@@ -857,6 +857,7 @@ def eval_point_poly(
 # ---------------------------------------------------------------------------
 
 
+@_jit_static0
 def affine_canon(cs: CurveSpec, pts: jax.Array) -> jax.Array:
     """Canonical (affine, Z=1) limb representation of a point batch:
     (..., C, L) -> (..., C, L) with X/Z, Y/Z (+ T = XY for Edwards);
@@ -899,6 +900,111 @@ def affine_canon(cs: CurveSpec, pts: jax.Array) -> jax.Array:
     return jnp.where(
         z_is_zero[..., None, None], jnp.broadcast_to(ident, out.shape), out
     )
+
+
+def _batch_zinv_host(zs: list[int], p: int) -> list[int]:
+    """Montgomery-trick inversion over host ints: one Fermat ``pow`` +
+    3(k-1) 256-bit modmuls for k nonzero lanes; zero lanes -> 0."""
+    prefix = [1] * len(zs)
+    acc = 1
+    for i, z in enumerate(zs):
+        prefix[i] = acc
+        if z:
+            acc = acc * z % p
+    inv_acc = pow(acc, p - 2, p)
+    out = [0] * len(zs)
+    for i in range(len(zs) - 1, -1, -1):
+        z = zs[i]
+        if z:
+            out[i] = inv_acc * prefix[i] % p
+            inv_acc = inv_acc * z % p
+    return out
+
+
+def encode_batch(cs: CurveSpec, pts) -> np.ndarray:
+    """Canonical compressed encodings for a whole point batch:
+    ``(..., C, L)`` -> ``(..., enc_len)`` uint8, each row bit-identical
+    to ``HostGroup.encode`` of that element (the DEM/KDF input and the
+    wire point format).
+
+    ONE batched Montgomery-trick inversion and ONE device->host
+    transfer cover the entire batch — vs the scalar path's per-point
+    ``to_affine`` inversion plus per-dealer ``to_host``.  WHERE the
+    inversion runs follows the backend: on TPU the device
+    :func:`affine_canon` pass (wide lanes are nearly free there); on
+    CPU the same trick over host big-ints — XLA:CPU field muls are
+    per-op-overhead-bound at DEM batch widths, so the device pass costs
+    ~100ms where 256-bit Python modmuls cost ~100ns each (the dealing
+    bench regression that motivated the dispatch).  Both legs produce
+    identical bytes (tests/test_dem_batch.py exercises both dispatches).
+    The ristretto ENCODE's inverse square root (RFC 9496 §4.3.2) has no
+    Montgomery-style batching, so Edwards finishes per point on the
+    affine host coordinates — still one transfer and one inversion pass.
+    """
+    f = cs.field
+    if fd._on_tpu():
+        aff = np.asarray(affine_canon(cs, jnp.asarray(pts)))
+        batch = aff.shape[:-2]
+        flat = aff.reshape((-1,) + aff.shape[-2:])
+        if cs.kind != "edwards":
+            nb = f.nbytes
+            x_le = np.ascontiguousarray(flat[:, 0, :].astype("<u2")).view(np.uint8)
+            out = np.empty((flat.shape[0], 1 + nb), dtype=np.uint8)
+            out[:, 0] = 2 + (flat[:, 1, 0] & 1).astype(np.uint8)
+            out[:, 1:] = x_le[:, nb - 1 :: -1]
+            # affine_canon maps zero-Z lanes to the canonical identity
+            # (0,1,0), whose wire form is the all-zero SEC encoding
+            out[(flat[:, 2, :] == 0).all(axis=1)] = 0
+            return out.reshape(batch + (1 + nb,))
+        affine = [
+            tuple(
+                int.from_bytes(
+                    np.ascontiguousarray(flat[i, c].astype("<u2")).tobytes(),
+                    "little",
+                )
+                for c in range(cs.ncoords)
+            )
+            for i in range(flat.shape[0])
+        ]
+    else:
+        pts_np = np.asarray(pts)  # the one transfer (no-op on host arrays)
+        batch = pts_np.shape[:-2]
+        flat = pts_np.reshape((-1,) + pts_np.shape[-2:])
+        le = np.ascontiguousarray(flat.astype("<u2")).view(np.uint8)
+        n_pts = flat.shape[0]
+        p = f.modulus
+        coords = [
+            [int.from_bytes(le[i, c].tobytes(), "little") for i in range(n_pts)]
+            for c in range(3)
+        ]
+        zinv = _batch_zinv_host(coords[2], p)
+        if cs.kind != "edwards":
+            nb = f.nbytes
+            out = np.zeros((n_pts, 1 + nb), dtype=np.uint8)
+            for i in range(n_pts):
+                zi = zinv[i]
+                if not zi:
+                    continue  # identity -> all-zero SEC encoding
+                y = coords[1][i] * zi % p
+                out[i, 0] = 2 + (y & 1)
+                out[i, 1:] = np.frombuffer(
+                    (coords[0][i] * zi % p).to_bytes(nb, "big"), dtype=np.uint8
+                )
+            return out.reshape(batch + (1 + nb,))
+        affine = []
+        for i in range(n_pts):
+            zi = zinv[i]
+            if zi:
+                x = coords[0][i] * zi % p
+                y = coords[1][i] * zi % p
+            else:  # canonical Edwards identity
+                x, y = 0, 1
+            affine.append((x, y, 1, x * y % p))
+    host = gh.ALL_GROUPS[cs.name]
+    out = np.empty((len(affine), 32), dtype=np.uint8)
+    for i, pt in enumerate(affine):
+        out[i] = np.frombuffer(host.encode(pt), dtype=np.uint8)
+    return out.reshape(batch + (32,))
 
 
 def window_step(

@@ -88,8 +88,10 @@ def cache_dir() -> pathlib.Path:
     ``dkg_tpu_fb_tables/`` directory alongside the JAX compilation
     cache (same lifecycle: wiping one should wipe both), falling back
     to the system temp dir when no compilation cache is configured."""
-    env = os.environ.get("DKG_TPU_TABLE_CACHE")
-    if env:
+    from ..utils import envknobs
+
+    env = envknobs.string("DKG_TPU_TABLE_CACHE", "fixed-base table cache directory")
+    if env is not None:
         return pathlib.Path(env)
     base = jax.config.jax_compilation_cache_dir or tempfile.gettempdir()
     return pathlib.Path(base) / "dkg_tpu_fb_tables"

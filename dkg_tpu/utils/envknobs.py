@@ -4,10 +4,17 @@ Every DKG_TPU_* knob that silently mis-parsing could turn into a wrong
 (possibly OOM or wrong-kernel) compiled program goes through here, so
 the validate-and-raise behavior cannot drift between copies (knobs:
 DKG_TPU_DEAL_CHUNK / DKG_TPU_VERIFY_CHUNK / DKG_TPU_RLC_CHUNK via
-dkg.ceremony._env_chunk, DKG_TPU_RLC via dkg.ceremony._point_rlc,
+dkg.ceremony._env_chunk, DKG_TPU_DEM / DKG_TPU_DEM_CHUNK via
+dkg.hybrid_batch, DKG_TPU_RLC via dkg.ceremony._point_rlc,
 DKG_TPU_MSM / DKG_TPU_FB_WINDOW / DKG_TPU_FUSED_MULTI /
 DKG_TPU_ED_FUSED_LADDER / DKG_TPU_ED_FUSED_DOUBLES via groups.device,
-DKG_TPU_NET_* transport knobs via net.channel).
+DKG_TPU_PALLAS / DKG_TPU_ASSUME_BACKEND via fields.device,
+DKG_TPU_MXU via fields.matmul, DKG_TPU_TABLE_CACHE via
+groups.precompute, DKG_TPU_NET_* transport knobs via net.channel).
+
+An EMPTY value is everywhere treated as unset: ``DKG_TPU_X= cmd`` is
+the shell idiom for clearing a knob on one invocation, and must select
+the default path, not raise.
 """
 
 from __future__ import annotations
@@ -16,15 +23,15 @@ import os
 
 
 def choice(name: str, options: tuple, what: str) -> str | None:
-    """None when ``name`` is unset, else its value validated against
-    ``options`` (a tuple of accepted strings).
+    """None when ``name`` is unset (or empty), else its value validated
+    against ``options`` (a tuple of accepted strings).
 
     Raises ValueError on anything else — enum knobs select compiled
     kernel paths (MSM algorithm, RLC schedule, fused dispatch), where a
     typo must fail loudly rather than silently run the default path.
     """
     env = os.environ.get(name)
-    if env is None:
+    if not env:
         return None
     if env not in options:
         raise ValueError(
@@ -42,7 +49,7 @@ def nonneg_int(name: str, what: str) -> int | None:
     the error message (e.g. "0 disables chunking").
     """
     env = os.environ.get(name)
-    if env is None:
+    if not env:
         return None
     try:
         v = int(env)
@@ -58,7 +65,7 @@ def nonneg_int(name: str, what: str) -> int | None:
 def pos_int(name: str, what: str) -> int | None:
     """None when ``name`` is unset, else its value as an int >= 1."""
     env = os.environ.get(name)
-    if env is None:
+    if not env:
         return None
     try:
         v = int(env)
@@ -72,7 +79,7 @@ def pos_int(name: str, what: str) -> int | None:
 def pos_float(name: str, what: str) -> float | None:
     """None when ``name`` is unset, else its value as a finite float > 0."""
     env = os.environ.get(name)
-    if env is None:
+    if not env:
         return None
     try:
         v = float(env)
@@ -83,10 +90,22 @@ def pos_float(name: str, what: str) -> float | None:
     return v
 
 
+def string(name: str, what: str) -> str | None:
+    """None when ``name`` is unset or empty, else its raw value.
+
+    For free-form knobs (paths, labels) where any non-empty string is
+    valid; exists so every DKG_TPU_* parse shares the one empty-is-unset
+    convention instead of re-implementing ``if env:`` truthiness.
+    ``what`` documents the knob for grep (e.g. "table cache directory").
+    """
+    del what  # documentation-only, kept for signature parity
+    return os.environ.get(name) or None
+
+
 def nonneg_float(name: str, what: str) -> float | None:
     """None when ``name`` is unset, else its value as a finite float >= 0."""
     env = os.environ.get(name)
-    if env is None:
+    if not env:
         return None
     try:
         v = float(env)

@@ -24,6 +24,12 @@ linter), so the committed baseline stays clean between CI runs:
         ``groups.precompute`` (``generator_table``/``base_table``) so
         the persistent cache actually covers every hot path
         (docs/perf.md)
+* DKG003  (dkg_tpu/dkg/ batch hot modules only) per-pair DEM primitive
+        in a hot path: ``group.encode(...)`` or ``chacha20_xor(...)``
+        called outside the scalar reference legs — the dealing pipeline
+        must use ``groups.device.encode_batch`` /
+        ``crypto.chacha.chacha20_xor_batch`` so n^2 pairs cost one
+        vectorized pass, not n^2 host calls (docs/perf.md)
 
 Exit 0 = clean.  Run: ``python scripts/lint_lite.py`` (from repo root).
 Also executed by tests/test_import_hygiene.py so the default test tier
@@ -67,6 +73,22 @@ _FIXED_TABLE_BUILDERS = {
     "_fixed_table_np",
 }
 
+# Batch hot modules under dkg_tpu/dkg/ where per-pair DEM primitives are
+# banned (DKG003): these run once per (dealer, recipient) pair, so a
+# scalar group.encode or chacha20_xor inside them is an O(n^2) host loop
+# the vectorized pipeline exists to eliminate.
+_DEM_HOT_MODULES = {
+    "hybrid_batch.py",
+    "committee_batch.py",
+    "complaints_batch.py",
+    "ceremony.py",
+}
+
+# Functions inside hot modules allowed to use scalar DEM primitives:
+# the scalar reference legs (DKG_TPU_DEM=scalar) that the byte-identity
+# tests diff the batch path against.
+_DEM_SCALAR_LEGS = {"seal_shares", "open_share"}
+
 
 class _Checker(ast.NodeVisitor):
     def __init__(self, path: pathlib.Path, tree: ast.Module, source: str):
@@ -79,6 +101,9 @@ class _Checker(ast.NodeVisitor):
         self._func_stack: list[str] = []
         self._net_module = "dkg_tpu/net/" in path.as_posix()
         self._dkg_module = "dkg_tpu/dkg/" in path.as_posix()
+        self._dem_hot_module = (
+            self._dkg_module and path.name in _DEM_HOT_MODULES
+        )
         self._collect_all(tree)
         self.visit(tree)
 
@@ -218,6 +243,31 @@ class _Checker(ast.NodeVisitor):
                     f"{name}() in dkg/ — use groups.precompute."
                     "generator_table/base_table so fixed-base tables hit "
                     "the persistent cache",
+                )
+        # DKG003: per-pair DEM primitives in batch hot modules — scalar
+        # group.encode / chacha20_xor inside the dealing pipeline is an
+        # O(n^2) host loop; route through encode_batch / *_xor_batch.
+        if self._dem_hot_module and not (set(self._func_stack) & _DEM_SCALAR_LEGS):
+            func = node.func
+            name = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else ""
+            )
+            per_pair = name == "chacha20_xor"
+            if not per_pair and name == "encode" and isinstance(func, ast.Attribute):
+                # only GROUP encodes: receiver named exactly ``group``
+                # (``fh.encode``/``str.encode`` etc. are fine)
+                recv = func.value
+                per_pair = (
+                    isinstance(recv, ast.Name) and recv.id == "group"
+                ) or (isinstance(recv, ast.Attribute) and recv.attr == "group")
+            if per_pair:
+                self._add(
+                    node,
+                    "DKG003",
+                    f"per-pair {name}() in a dkg/ hot path — use "
+                    "groups.device.encode_batch / crypto.chacha."
+                    "chacha20_xor_batch (scalar legs: seal_shares/"
+                    "open_share only)",
                 )
         self.generic_visit(node)
 

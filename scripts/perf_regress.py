@@ -5,7 +5,9 @@ bench's single JSON line under ``parsed`` (bench.py docstring).  This
 script diffs the NEWEST TWO rounds' headline metric
 (``share_verify_pairs_per_sec_per_chip``) and FAILS (exit 1) when the
 newer rate dropped more than 20% below the older one — the tripwire
-that catches a perf_opt PR quietly un-doing a previous one.
+that catches a perf_opt PR quietly un-doing a previous one.  The
+dealing-phase metric (``config.pairs_sealed_per_s``, the vectorized
+KEM+DEM pipeline) is gated the same way when both rounds carry it.
 
 Deliberately forgiving about everything except a real regression:
 
@@ -89,11 +91,41 @@ def main(argv: list[str] | None = None) -> int:
         f"perf_regress: r{old_n} {old_v:.1f} -> r{new_n} {new_v:.1f} "
         f"{new.get('unit', '')} ({change:+.1%}) on {new_plat}"
     )
+    bad = 0
     if change < -args.threshold:
         print(f"{line} — REGRESSION beyond {args.threshold:.0%}", file=sys.stderr)
-        return 1
-    print(line)
-    return 0
+        bad = 1
+    else:
+        print(line)
+    # dealing-phase gate: config.pairs_sealed_per_s (the vectorized
+    # KEM+DEM pipeline, bench.py docstring) — same forgiveness as the
+    # headline: rounds predating the metric (or with a failed seal leg)
+    # skip with a note rather than blocking.
+    old_d = (old.get("config") or {}).get("pairs_sealed_per_s")
+    new_d = (new.get("config") or {}).get("pairs_sealed_per_s")
+    if (
+        isinstance(old_d, (int, float)) and old_d > 0
+        and isinstance(new_d, (int, float)) and new_d > 0
+    ):
+        dchange = (new_d - old_d) / old_d
+        dline = (
+            f"perf_regress: dealing r{old_n} {old_d:.1f} -> r{new_n} "
+            f"{new_d:.1f} pairs-sealed/s ({dchange:+.1%}) on {new_plat}"
+        )
+        if dchange < -args.threshold:
+            print(
+                f"{dline} — REGRESSION beyond {args.threshold:.0%}",
+                file=sys.stderr,
+            )
+            bad = 1
+        else:
+            print(dline)
+    else:
+        print(
+            f"perf_regress: pairs_sealed_per_s absent in r{old_n} or "
+            f"r{new_n} — skipping dealing gate"
+        )
+    return bad
 
 
 if __name__ == "__main__":

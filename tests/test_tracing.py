@@ -34,6 +34,33 @@ def test_trace_rates_per_phase():
     assert rates == {"deal": 50.0, "verify": 200.0}
 
 
+def test_batched_dealing_traces_seal_phase():
+    """Dealing traces split engine time (``deal``) from the KEM+DEM
+    pipeline (``seal``) and count the pairs the seal span covered."""
+    from dkg_tpu.dkg.committee import Environment
+    from dkg_tpu.dkg.committee_batch import batched_dealing
+    from dkg_tpu.dkg.procedure_keys import MemberCommunicationKey
+    from dkg_tpu.groups import host as gh
+
+    rng = random.Random(0x7ACE)
+    g = gh.RISTRETTO255
+    n, t = 3, 1
+    env = Environment.init(g, t, n, b"traced-deal")
+    keys = [MemberCommunicationKey.generate(g, rng) for _ in range(n)]
+    tr = CeremonyTrace()
+    dealt = batched_dealing(env, rng, keys, trace=tr)
+    assert len(dealt) == n
+    assert {"deal", "seal"} <= set(tr.timings_s)
+    assert tr.timings_s["seal"] > 0
+    assert tr.counters["pairs_sealed"] == n * n
+    # rates() exposes the dealing throughput bench.py reports
+    assert tr.rates(n * n)["seal"] == pytest.approx(
+        n * n / tr.timings_s["seal"]
+    )
+    # trace=None stays a no-op path
+    assert len(batched_dealing(env, rng, keys)) == n
+
+
 @pytest.mark.slow  # a second full engine compile; nightly tier
 def test_ceremony_run_with_trace():
     rng = random.Random(1)
