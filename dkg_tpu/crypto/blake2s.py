@@ -1,0 +1,124 @@
+"""Vectorized BLAKE2s compression (RFC 7693), numpy u32 lanes.
+
+The host leg of the transcript Merkle tree (crypto/device_hash.py): on
+CPU backends the XLA lowering of the device tree pays per-op dispatch
+overhead on thousands of tiny uint32 ops — the same pathology that
+motivated the host leg of ``groups.device.encode_batch`` — so the
+digest dispatcher (``device_hash.digest_dispatch``) routes CPU
+transcripts here instead.  One numpy dispatch per G-call covers every
+node of a tree level at once: the whole (n, n) share tensor digests in
+a handful of array ops.
+
+Bit-exactness contract: :func:`row_digests_np` computes EXACTLY the
+tree mode documented in ``device_hash`` (same IV/parameter words, same
+leaf/interior/root domain separation, same padding) — the pure-Python
+twin ``device_hash.tree_digest_host`` is the oracle, and
+``tests/test_blake2s.py`` diffs both the raw compression function
+(against ``device_hash._compress_py``) and whole trees on random
+shapes.  This is the sibling of ``crypto/blake2.py`` (the u64 BLAKE2b
+batch the DEM KDF and Fiat-Shamir rho derivation use); BLAKE2s keeps
+its own file because the tree constants and 32-bit rotation schedule
+are the transcript hash's spec, not a digest-size parameter.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# The tree-mode constants are owned by device_hash (the construction's
+# spec lives in its module docstring); this module is numpy-only apart
+# from this import, which device_hash defers at call time to avoid a
+# cycle.
+from .device_hash import IV, MASK32, P3_LEAF, P3_NODE, P_WORD0, SIGMA
+
+_IV32 = np.asarray(IV, np.uint32)
+
+
+def _rotr(x: np.ndarray, n: int) -> np.ndarray:
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def _g(v: np.ndarray, a: int, b: int, c: int, d: int, x: np.ndarray, y: np.ndarray) -> None:
+    """RFC 7693 §3.1 mixing function G on ``(N, 16)`` u32 work vectors
+    (BLAKE2s rotation schedule: 16/12/8/7)."""
+    v[:, a] += v[:, b] + x
+    v[:, d] = _rotr(v[:, d] ^ v[:, a], 16)
+    v[:, c] += v[:, d]
+    v[:, b] = _rotr(v[:, b] ^ v[:, c], 12)
+    v[:, a] += v[:, b] + y
+    v[:, d] = _rotr(v[:, d] ^ v[:, a], 8)
+    v[:, c] += v[:, d]
+    v[:, b] = _rotr(v[:, b] ^ v[:, c], 7)
+
+
+def compress_batch(h: np.ndarray, m: np.ndarray, t, f0: int) -> np.ndarray:
+    """Batched BLAKE2s compression F: ``h`` (N, 8), ``m`` (N, 16),
+    ``t`` scalar or (N,), ``f0`` scalar -> (N, 8).  All uint32; row i
+    equals ``device_hash._compress_py(h[i], m[i], t[i], f0)``.
+
+    t_hi is always 0 for our < 2^32-byte chunks (same contract as the
+    device twin)."""
+    h = np.asarray(h, np.uint32)
+    m = np.asarray(m, np.uint32)
+    n = h.shape[0]
+    v = np.empty((n, 16), np.uint32)
+    v[:, :8] = h
+    v[:, 8:] = _IV32
+    with np.errstate(over="ignore"):
+        v[:, 12] ^= np.asarray(t, np.uint32)
+        v[:, 14] ^= np.uint32(f0 & MASK32)
+        for s in SIGMA:
+            _g(v, 0, 4, 8, 12, m[:, s[0]], m[:, s[1]])
+            _g(v, 1, 5, 9, 13, m[:, s[2]], m[:, s[3]])
+            _g(v, 2, 6, 10, 14, m[:, s[4]], m[:, s[5]])
+            _g(v, 3, 7, 11, 15, m[:, s[6]], m[:, s[7]])
+            _g(v, 0, 5, 10, 15, m[:, s[8]], m[:, s[9]])
+            _g(v, 1, 6, 11, 12, m[:, s[10]], m[:, s[11]])
+            _g(v, 2, 7, 8, 13, m[:, s[12]], m[:, s[13]])
+            _g(v, 3, 4, 9, 14, m[:, s[14]], m[:, s[15]])
+        return h ^ v[:, :8] ^ v[:, 8:]
+
+
+def _h_init(p3: int, n: int) -> np.ndarray:
+    h = np.broadcast_to(_IV32, (n, 8)).copy()
+    h[:, 0] ^= np.uint32(P_WORD0)
+    h[:, 3] ^= np.uint32(p3)
+    return h
+
+
+def row_digests_np(words: np.ndarray, domain: int = 0) -> np.ndarray:
+    """Independent Merkle digest per row: (R, W) uint32 -> (R, 8) uint32.
+
+    Numpy twin of ``device_hash._tree_from_words`` — every tree level is
+    ONE ``compress_batch`` over all of that level's nodes across all
+    rows, so the op count is O(log blocks), not O(nodes)."""
+    words = np.ascontiguousarray(words, np.uint32)
+    r, w = words.shape
+    nl = max(1, -(-w // 16))
+    nl_pow2 = 1 << (nl - 1).bit_length()
+    pad = nl_pow2 * 16 - w
+    if pad:
+        words = np.concatenate([words, np.zeros((r, pad), np.uint32)], axis=-1)
+    blocks = words.reshape(r * nl_pow2, 16)
+    t_leaf = np.tile(np.arange(nl_pow2, dtype=np.uint32) * 64, r)
+    h = compress_batch(_h_init(P3_LEAF, r * nl_pow2), blocks, t_leaf, MASK32)
+    h = h.reshape(r, nl_pow2, 8)
+    level = 1
+    while h.shape[1] > 1:
+        k = h.shape[1] // 2
+        pairs = h.reshape(r * k, 16)
+        h = compress_batch(_h_init(P3_NODE, r * k), pairs, level, MASK32)
+        h = h.reshape(r, k, 8)
+        level += 1
+    tail = np.zeros((r, 8), np.uint32)
+    tail[:, 0] = np.uint32(w & MASK32)
+    tail[:, 1] = np.uint32(domain & MASK32)
+    root_block = np.concatenate([h[:, 0, :], tail], axis=-1)
+    return compress_batch(_h_init(P3_NODE, r), root_block, 0, MASK32)
+
+
+def tree_digest_np(words, domain: int = 0) -> np.ndarray:
+    """Single-stream numpy twin of ``device_hash.tree_digest``:
+    any uint32 array -> (8,) uint32."""
+    flat = np.asarray(words, np.uint32).reshape(1, -1)
+    return row_digests_np(flat, domain)[0]

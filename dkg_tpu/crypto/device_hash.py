@@ -34,6 +34,20 @@ that of the BLAKE2s compression function.
 
 The pure-Python twin (``tree_digest_host``) is the test oracle and the
 multi-host fold reference.
+
+Dispatch: the public entry points (:func:`tree_digest`,
+:func:`row_digests`) are BACKEND-DISPATCHED.  The device leg runs the
+whole tree as ONE jitted program per (shape, domain-arity) — rounds
+roll up in a ``lax.fori_loop`` and the four column/diagonal G-calls of
+each half-round vectorize over a 4-wide lane axis, so the traced graph
+stays small and the per-op XLA dispatch that made the eager tree the
+ceremony's slowest phase (BENCH_r06: 5.5 s at n=64 on CPU) disappears.
+The host leg (``crypto.blake2s``) is the same tree in batched numpy —
+on CPU backends XLA per-op overhead dominates the tiny uint32 ops
+exactly as it did for point encoding (``groups.device.encode_batch``),
+so ``digest_dispatch`` routes CPU transcripts there.  Both legs are
+bit-identical; ``DKG_TPU_DIGEST=device|host|auto`` (validated) forces a
+leg.
 """
 
 from __future__ import annotations
@@ -42,6 +56,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 IV = (
     0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
@@ -83,14 +98,25 @@ def _ror(x, n):
 
 def _compress_dev(h, m, t, f0):
     """Batched BLAKE2s compression: h (..., 8), m (..., 16), t (...,) or
-    scalar, f0 scalar -> (..., 8).  All uint32."""
+    scalar, f0 scalar -> (..., 8).  All uint32.
+
+    Trace-size discipline (this runs INSIDE the jitted tree): the ten
+    rounds roll up in a ``lax.fori_loop`` with the message schedule as a
+    gathered (10, 16) constant, and each half-round's four independent
+    G-calls run as ONE G over a 4-wide lane axis — the standard
+    column/diagonal formulation (diagonals are lane-rolls of the state
+    quarters).  The traced graph is ~2 G-bodies instead of 80, so a
+    whole Merkle level compiles in milliseconds while the compiled code
+    is identical arithmetic to the unrolled form."""
     t = jnp.asarray(t, jnp.uint32)
-    v = [h[..., i] for i in range(8)] + [
-        jnp.broadcast_to(jnp.uint32(IV[i]), h.shape[:-1]) for i in range(8)
-    ]
-    v[12] = v[12] ^ t  # t_hi is always 0 for our <2^32-byte chunks
-    v[14] = v[14] ^ jnp.uint32(f0)
-    msg = [m[..., i] for i in range(16)]
+    batch = jnp.broadcast_shapes(h.shape[:-1], m.shape[:-1], t.shape)
+    h = jnp.broadcast_to(h, batch + (8,))
+    m = jnp.broadcast_to(m, batch + (16,))
+    iv = jnp.asarray(np.asarray(IV, np.uint32))
+    v = jnp.concatenate([h, jnp.broadcast_to(iv, h.shape)], axis=-1)
+    v = v.at[..., 12].set(v[..., 12] ^ jnp.broadcast_to(t, batch))
+    v = v.at[..., 14].set(v[..., 14] ^ jnp.uint32(f0))
+    sigma = jnp.asarray(np.asarray(SIGMA, np.int32))
 
     def g(a, b, c, d, x, y):
         a = a + b + x  # uint32 wraps mod 2^32 natively
@@ -103,20 +129,23 @@ def _compress_dev(h, m, t, f0):
         b = _ror(b ^ c, 7)
         return a, b, c, d
 
-    for rnd in range(10):
-        s = SIGMA[rnd]
-        v[0], v[4], v[8], v[12] = g(v[0], v[4], v[8], v[12], msg[s[0]], msg[s[1]])
-        v[1], v[5], v[9], v[13] = g(v[1], v[5], v[9], v[13], msg[s[2]], msg[s[3]])
-        v[2], v[6], v[10], v[14] = g(v[2], v[6], v[10], v[14], msg[s[4]], msg[s[5]])
-        v[3], v[7], v[11], v[15] = g(v[3], v[7], v[11], v[15], msg[s[6]], msg[s[7]])
-        v[0], v[5], v[10], v[15] = g(v[0], v[5], v[10], v[15], msg[s[8]], msg[s[9]])
-        v[1], v[6], v[11], v[12] = g(v[1], v[6], v[11], v[12], msg[s[10]], msg[s[11]])
-        v[2], v[7], v[8], v[13] = g(v[2], v[7], v[8], v[13], msg[s[12]], msg[s[13]])
-        v[3], v[4], v[9], v[14] = g(v[3], v[4], v[9], v[14], msg[s[14]], msg[s[15]])
+    def round_body(rnd, v):
+        ms = jnp.take(m, sigma[rnd], axis=-1)
+        a, b, c, d = (v[..., 0:4], v[..., 4:8], v[..., 8:12], v[..., 12:16])
+        # columns: G(v0,v4,v8,v12) .. G(v3,v7,v11,v15)
+        a, b, c, d = g(a, b, c, d, ms[..., 0:8:2], ms[..., 1:8:2])
+        # diagonals: G(v0,v5,v10,v15) .. G(v3,v4,v9,v14) == lane rolls
+        b = jnp.roll(b, -1, axis=-1)
+        c = jnp.roll(c, -2, axis=-1)
+        d = jnp.roll(d, -3, axis=-1)
+        a, b, c, d = g(a, b, c, d, ms[..., 8:16:2], ms[..., 9:16:2])
+        b = jnp.roll(b, 1, axis=-1)
+        c = jnp.roll(c, 2, axis=-1)
+        d = jnp.roll(d, 3, axis=-1)
+        return jnp.concatenate([a, b, c, d], axis=-1)
 
-    return jnp.stack(
-        [h[..., i] ^ v[i] ^ v[i + 8] for i in range(8)], axis=-1
-    )
+    v = lax.fori_loop(0, 10, round_body, v)
+    return h ^ v[..., 0:8] ^ v[..., 8:16]
 
 
 def _h_init(p3: int, batch: tuple) -> jax.Array:
@@ -137,52 +166,102 @@ def _pad_blocks(words: jax.Array) -> jax.Array:
     return words.reshape(words.shape[:-1] + (nl_pow2, 16))
 
 
-def tree_digest(tensor: jax.Array, domain: int = 0) -> jax.Array:
+def digest_dispatch() -> str:
+    """Which transcript-digest leg runs: ``"device"`` or ``"host"``.
+
+    ``DKG_TPU_DIGEST=device|host|auto`` (validated via envknobs — a typo
+    must fail loudly, not silently measure the wrong leg) forces it;
+    ``auto``/unset picks the jitted device tree on TPU and the batched
+    numpy tree (``crypto.blake2s``) elsewhere, where XLA per-op overhead
+    on tiny uint32 ops dominates.  Both legs are bit-identical
+    (tests/test_digest_dispatch.py), so the choice is pure performance —
+    rho never depends on it.
+    """
+    from ..fields import device as fd
+    from ..utils import envknobs
+
+    mode = envknobs.choice(
+        "DKG_TPU_DIGEST",
+        ("device", "host", "auto"),
+        "a typo would silently run the slow digest leg",
+    )
+    if mode is None or mode == "auto":
+        return "device" if fd._on_tpu() else "host"
+    return mode
+
+
+def tree_digest(tensor, domain: int = 0, dispatch: str | None = None):
     """Merkle digest of a uint32 tensor's words -> (8,) uint32.
 
     Leading axes before the last are flattened into the word stream;
     use :func:`row_digests` to keep a batch axis independent.
+    Backend-dispatched (see :func:`digest_dispatch`); ``dispatch``
+    pins a leg (the cross-leg equality tests do).
     """
+    if dispatch is None:
+        dispatch = digest_dispatch()
+    if dispatch == "host":
+        from . import blake2s
+
+        return blake2s.tree_digest_np(np.asarray(tensor), domain)
     words = jnp.asarray(tensor, jnp.uint32).reshape(-1)
     return _tree_from_words(words[None, :], domain)[0]
 
 
-def row_digests(tensor: jax.Array, domain: int = 0) -> jax.Array:
+def row_digests(tensor, domain: int = 0, dispatch: str | None = None):
     """Independent Merkle digest per row: (R, ...) -> (R, 8) uint32.
 
     Each row's digest depends only on that row (and the shared shape),
     so dealer-sharded tensors hash shard-locally and only (R, 8) words
     ever need to cross hosts — the shard-foldable structure
-    transcript hashing requires.
+    transcript hashing requires.  Backend-dispatched like
+    :func:`tree_digest`; the host leg returns numpy, the device leg a
+    jax array (every consumer folds through ``np.asarray`` anyway).
     """
+    if dispatch is None:
+        dispatch = digest_dispatch()
+    if dispatch == "host":
+        from . import blake2s
+
+        t = np.asarray(tensor)
+        return blake2s.row_digests_np(t.reshape(t.shape[0], -1), domain)
     t = jnp.asarray(tensor, jnp.uint32)
     return _tree_from_words(t.reshape(t.shape[0], -1), domain)
 
 
 def _tree_from_words(words: jax.Array, domain: int) -> jax.Array:
+    """Jit entry for the device tree: one compiled program per (R, W)
+    shape, shared across domains (the domain tag rides in as a traced
+    scalar, so the rows_a/rows_e calls of ``_dealer_rows_device`` — same
+    shape, different domain — reuse one executable)."""
+    return _tree_from_words_jit(
+        jnp.asarray(words, jnp.uint32), jnp.uint32(int(domain) & MASK32)
+    )
+
+
+@jax.jit
+def _tree_from_words_jit(words: jax.Array, domain: jax.Array) -> jax.Array:
     r, w = words.shape
     blocks = _pad_blocks(words)  # (R, NL, 16)
     nl = blocks.shape[-2]
     t_leaf = jnp.arange(nl, dtype=jnp.uint32) * 64
     h = _compress_dev(_h_init(P3_LEAF, (r, nl)), blocks, t_leaf[None, :], MASK32)
     level = 1
-    while h.shape[-2] > 1:
+    while h.shape[-2] > 1:  # trace-time loop: log2(NL) compressions
         pairs = h.reshape(r, h.shape[-2] // 2, 16)
         h = _compress_dev(
             _h_init(P3_NODE, pairs.shape[:-1]), pairs, jnp.uint32(level), MASK32
         )
         level += 1
+    tail = (
+        jnp.zeros((8,), jnp.uint32)
+        .at[0]
+        .set(jnp.uint32(w & MASK32))
+        .at[1]
+        .set(domain)
+    )
     root_block = jnp.concatenate(
-        [
-            h[:, 0, :],
-            jnp.broadcast_to(
-                jnp.asarray(
-                    [w & MASK32, domain & MASK32, 0, 0, 0, 0, 0, 0], jnp.uint32
-                ),
-                (r, 8),
-            ),
-        ],
-        axis=-1,
+        [h[:, 0, :], jnp.broadcast_to(tail, (r, 8))], axis=-1
     )
     return _compress_dev(_h_init(P3_NODE, (r,)), root_block, jnp.uint32(0), MASK32)
 

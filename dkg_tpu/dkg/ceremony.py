@@ -34,6 +34,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import hashlib
+import time
 
 import jax
 import jax.numpy as jnp
@@ -549,7 +550,8 @@ def _fold_digest_device(cfg: CeremonyConfig, rows_a, rows_e, rows_sr) -> bytes:
     return h.digest()
 
 
-def _dealer_rows_device(cfg: CeremonyConfig, a_comm, e_comm, shares, hidings):
+def _dealer_rows_device(cfg: CeremonyConfig, a_comm, e_comm, shares, hidings,
+                        dispatch: str | None = None):
     """Per-dealer BLAKE2s row digests of all four round-1 tensors:
     (k, ...) local-dealer slices -> three (k, 8) uint32 arrays.
 
@@ -557,26 +559,47 @@ def _dealer_rows_device(cfg: CeremonyConfig, a_comm, e_comm, shares, hidings):
     flat), so EVERY part of the transcript is shard-foldable — a mesh
     that keeps commitments dealer-sharded (no allgather) still derives
     the canonical digest by exchanging 3 x 32 bytes per dealer.
+
+    Backend-dispatched (``device_hash.digest_dispatch``): the device leg
+    canonicalises and Merkle-hashes on device (one jitted program per
+    tensor shape); the host leg moves the tensors once and runs the
+    big-int canonicalisation (``gd.affine_canon_host``) plus the batched
+    numpy tree — on CPU that replaces the XLA per-op-overhead path that
+    made fiat_shamir the slowest ceremony phase.  Both legs produce the
+    SAME three row-digest arrays bit for bit.
     """
     from ..crypto import device_hash as dh
 
+    if dispatch is None:
+        dispatch = dh.digest_dispatch()
     k = shares.shape[0]
     # Commitments are digested in CANONICAL affine form: projective Z
     # scale depends on the addition schedule (platform/flags), and rho
     # must be a function of the logical transcript, not of which kernel
     # computed it (gd.affine_canon's docstring has the full argument).
-    a_canon = gd.affine_canon(cfg.cs, jnp.asarray(a_comm))
-    e_canon = gd.affine_canon(cfg.cs, jnp.asarray(e_comm))
-    rows_a = dh.row_digests(jnp.asarray(a_canon, jnp.uint32).reshape(k, -1), domain=1)
-    rows_e = dh.row_digests(jnp.asarray(e_canon, jnp.uint32).reshape(k, -1), domain=2)
-    sr = jnp.concatenate(
-        [
-            jnp.asarray(shares, jnp.uint32).reshape(k, -1),
-            jnp.asarray(hidings, jnp.uint32).reshape(k, -1),
-        ],
-        axis=-1,
-    )
-    rows_sr = dh.row_digests(sr, domain=3)
+    if dispatch == "host":
+        a_canon = gd.affine_canon_host(cfg.cs, np.asarray(a_comm))
+        e_canon = gd.affine_canon_host(cfg.cs, np.asarray(e_comm))
+        sr = np.concatenate(
+            [
+                np.asarray(shares).reshape(k, -1),
+                np.asarray(hidings).reshape(k, -1),
+            ],
+            axis=-1,
+        )
+    else:
+        a_canon = gd.affine_canon(cfg.cs, jnp.asarray(a_comm))
+        e_canon = gd.affine_canon(cfg.cs, jnp.asarray(e_comm))
+        sr = jnp.concatenate(
+            [
+                jnp.asarray(shares, jnp.uint32).reshape(k, -1),
+                jnp.asarray(hidings, jnp.uint32).reshape(k, -1),
+            ],
+            axis=-1,
+        )
+    rows_a = dh.row_digests(a_canon.reshape(k, -1), domain=1, dispatch=dispatch)
+    rows_e = dh.row_digests(e_canon.reshape(k, -1), domain=2, dispatch=dispatch)
+    rows_sr = dh.row_digests(sr, domain=3, dispatch=dispatch)
     return rows_a, rows_e, rows_sr
 
 
@@ -650,9 +673,17 @@ def sharded_transcript_digest(cfg: CeremonyConfig, a, e, s, r) -> bytes:
     seen = set()
     for sh_a, sh_e, sh_s, sh_r in zip(*per):
         sl = sh_s.index[0]
-        assert sh_r.index[0] == sl and sh_a.index[0] == sl and sh_e.index[0] == sl, (
-            "round-1 tensors must be sharded identically on the dealer axis"
-        )
+        if not (sh_r.index[0] == sl and sh_a.index[0] == sl and sh_e.index[0] == sl):
+            # typed, not an assert: a mixed dealer layout would silently
+            # fold the WRONG rows into the digest under ``python -O``
+            # (asserts compile away) — and a wrong-but-valid rho is a
+            # soundness bug, not a crash.
+            raise ValueError(
+                "sharded_transcript_digest: round-1 tensors must share one "
+                "dealer-axis layout (all dealer-sharded identically or all "
+                f"replicated); got a/e/s/r slices "
+                f"{sh_a.index[0]}/{sh_e.index[0]}/{sl}/{sh_r.index[0]}"
+            )
         if (sl.start, sl.stop) in seen:  # replicated shard copy
             continue
         seen.add((sl.start, sl.stop))
@@ -675,26 +706,56 @@ def fiat_shamir_rho(cfg: CeremonyConfig, transcript: bytes, rho_bits: int) -> np
     transcript (publicly recomputable, so the batch check is itself
     verifiable).  ``transcript`` must be a binding digest of the full
     round-1 broadcast — use :func:`transcript_digest`.  Returns (n, L)
-    uint32 limbs with rho_bits entropy."""
+    uint32 limbs with rho_bits entropy.
+
+    One ``crypto.blake2.blake2b_batch`` call derives all n lanes — at
+    n=4096 the former per-dealer ``hashlib`` loop was 4096 sequential
+    host hashes; now it is one (n, 36)-byte array op, byte-identical
+    per lane (tests/test_digest_dispatch.py pins pre-vectorization
+    golden outputs)."""
+    from ..crypto.blake2 import blake2b_batch
+
     fs = cfg.cs.scalar
-    out = np.zeros((cfg.n, fs.limbs), np.uint32)
     nbytes = (rho_bits + 7) // 8
     # mask to EXACTLY rho_bits: the point side (_point_rlc) consumes only
     # the low rho_bits, while the field side (_field_dot) consumes every
     # set bit — they must see the same weights for any rho_bits.
     mask = (1 << rho_bits) - 1
-    for j in range(cfg.n):
-        h = hashlib.blake2b(
-            transcript + j.to_bytes(4, "little"), digest_size=nbytes,
-            person=b"dkgtpu-rlc",
-        ).digest()
-        out[j] = fh.encode(fs, int.from_bytes(h, "little") & mask)
+    tlen = len(transcript)
+    msgs = np.zeros((cfg.n, tlen + 4), np.uint8)
+    msgs[:, :tlen] = np.frombuffer(transcript, np.uint8)
+    msgs[:, tlen:] = (
+        np.arange(cfg.n, dtype="<u4").reshape(cfg.n, 1).view(np.uint8)
+    )
+    dig = blake2b_batch(msgs, digest_size=nbytes, person=b"dkgtpu-rlc")
+    out = np.zeros((cfg.n, fs.limbs), np.uint32)
+    if (1 << rho_bits) > fs.modulus:
+        # masked value may exceed the scalar modulus: reduce per lane
+        # exactly as fh.encode always has (rare — rho_bits at/above the
+        # field size; the vector path below must not re-implement the
+        # reduction)
+        for j in range(cfg.n):
+            out[j] = fh.encode(
+                fs, int.from_bytes(dig[j].tobytes(), "little") & mask
+            )
+        return out
+    # little-endian bytes -> 16-bit limbs, masked to exactly rho_bits
+    nlimb = min((nbytes + 1) // 2, fs.limbs)
+    buf = np.zeros((cfg.n, nlimb * 2), np.uint8)
+    buf[:, :nbytes] = dig
+    limbs16 = np.ascontiguousarray(buf).view("<u2").astype(np.uint32)
+    full, rem = divmod(rho_bits, 16)
+    if rem and full < nlimb:
+        limbs16[:, full] &= (1 << rem) - 1
+    if full + (1 if rem else 0) < nlimb:
+        limbs16[:, full + (1 if rem else 0):] = 0
+    out[:, :nlimb] = limbs16
     return out
 
 
 def derive_rho(
     cfg: CeremonyConfig, a_comm, e_comm, shares, hidings, rho_bits: int,
-    *, device: bool = True,
+    *, device: bool = True, trace=None,
 ) -> np.ndarray:
     """rho from the real round-1 transcript — the only sound way to get
     batch randomizers (every caller path: engine, bench, sharded,
@@ -705,14 +766,29 @@ def derive_rho(
     round 4) the second share check, so a dealer must not be able to
     pick A after seeing rho any more than E/s/r.
 
-    ``device=True`` (default) hashes the tensors on-device
-    (:func:`transcript_digest_device`) so only digests cross to host;
-    ``device=False`` uses the byte-level host digest.
+    ``device=True`` (default) hashes the tensors with the Merkle family
+    (:func:`transcript_digest_device`), whose backend leg — jitted
+    device tree vs numpy batch — is picked by
+    ``crypto.device_hash.digest_dispatch`` (DKG_TPU_DIGEST knob);
+    ``device=False`` uses the byte-level host audit digest.
+
+    Pass a :class:`dkg_tpu.utils.tracing.CeremonyTrace` to split the
+    fiat_shamir span into ``digest`` / ``rho`` sub-timings and record
+    which digest leg ran (``digest_dispatch`` meta field).
     """
+    from ..crypto import device_hash as dh
+
+    dispatch = dh.digest_dispatch() if device else "audit"
     digest_fn = transcript_digest_device if device else transcript_digest
-    return fiat_shamir_rho(
-        cfg, digest_fn(cfg, a_comm, e_comm, shares, hidings), rho_bits
-    )
+    t0 = time.perf_counter()
+    transcript = digest_fn(cfg, a_comm, e_comm, shares, hidings)
+    t1 = time.perf_counter()
+    rho = fiat_shamir_rho(cfg, transcript, rho_bits)
+    if trace is not None:
+        trace.record_sub("fiat_shamir", "digest", t1 - t0)
+        trace.record_sub("fiat_shamir", "rho", time.perf_counter() - t1)
+        trace.meta["digest_dispatch"] = dispatch
+    return rho
 
 
 class BatchedCeremony:
@@ -800,7 +876,7 @@ class BatchedCeremony:
         if tamper is not None:
             a, e, s, r = tamper(a, e, s, r)
         with phase_span(trace, "fiat_shamir"):
-            rho = jnp.asarray(derive_rho(cfg, a, e, s, r, rho_bits))
+            rho = jnp.asarray(derive_rho(cfg, a, e, s, r, rho_bits, trace=trace))
         with phase_span(trace, "verify"):
             ok = verify_batch(cfg, e, s, r, rho, rho_bits, self.g_table, self.h_table)
             _jax.block_until_ready(ok)

@@ -921,6 +921,50 @@ def _batch_zinv_host(zs: list[int], p: int) -> list[int]:
     return out
 
 
+def affine_canon_host(cs: CurveSpec, pts) -> np.ndarray:
+    """Host big-int twin of :func:`affine_canon`: (..., C, L) limbs ->
+    (..., C, L) uint32 canonical affine limbs, bit-identical to the
+    device pass on the same points (zero-Z lanes map to the canonical
+    identity, Z=1, Edwards T=XY).
+
+    Exists for the transcript-digest host leg: on CPU the jitted device
+    canonicalisation pays an XLA Fermat-inversion ladder where one
+    Montgomery-trick pass over 256-bit Python ints costs microseconds —
+    the same backend economics as :func:`encode_batch`'s host leg, which
+    shares :func:`_batch_zinv_host`.
+    """
+    f = cs.field
+    pts_np = np.asarray(pts)
+    shape = pts_np.shape
+    flat = pts_np.reshape((-1,) + shape[-2:])
+    le = np.ascontiguousarray(flat.astype("<u2")).view(np.uint8)
+    n_pts = flat.shape[0]
+    p = f.modulus
+    coords = [
+        [int.from_bytes(le[i, c].tobytes(), "little") for i in range(n_pts)]
+        for c in range(cs.ncoords)
+    ]
+    zinv = _batch_zinv_host(coords[2], p)
+    ident = np.asarray(identity(cs), np.uint32)
+    out = np.empty((n_pts,) + shape[-2:], np.uint32)
+    from ..fields.spec import int_to_limbs
+
+    for i in range(n_pts):
+        zi = zinv[i]
+        if not zi:
+            out[i] = ident
+            continue
+        x = coords[0][i] * zi % p
+        y = coords[1][i] * zi % p
+        out[i, 0] = int_to_limbs(x, f.limbs)
+        out[i, 1] = int_to_limbs(y, f.limbs)
+        out[i, 2] = 0
+        out[i, 2, 0] = 1
+        if cs.kind == "edwards":
+            out[i, 3] = int_to_limbs(x * y % p, f.limbs)
+    return out.reshape(shape)
+
+
 def encode_batch(cs: CurveSpec, pts) -> np.ndarray:
     """Canonical compressed encodings for a whole point batch:
     ``(..., C, L)`` -> ``(..., enc_len)`` uint8, each row bit-identical

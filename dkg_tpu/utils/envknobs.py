@@ -10,7 +10,8 @@ DKG_TPU_MSM / DKG_TPU_FB_WINDOW / DKG_TPU_FUSED_MULTI /
 DKG_TPU_ED_FUSED_LADDER / DKG_TPU_ED_FUSED_DOUBLES via groups.device,
 DKG_TPU_PALLAS / DKG_TPU_ASSUME_BACKEND via fields.device,
 DKG_TPU_MXU via fields.matmul, DKG_TPU_TABLE_CACHE via
-groups.precompute, DKG_TPU_NET_* transport knobs via net.channel).
+groups.precompute, DKG_TPU_NET_* transport knobs via net.channel,
+DKG_TPU_DIGEST via crypto.device_hash.digest_dispatch).
 
 An EMPTY value is everywhere treated as unset: ``DKG_TPU_X= cmd`` is
 the shell idiom for clearing a knob on one invocation, and must select

@@ -27,12 +27,21 @@ class CeremonyTrace:
     timings_s: dict = field(default_factory=dict)  # phase -> seconds
     counters: dict = field(default_factory=dict)  # name -> int
     meta: dict = field(default_factory=dict)
+    # phase -> {sub -> seconds}; finer-grained than timings_s and kept
+    # OUT of it so rates()/total_s never double-count a phase
+    subtimings_s: dict = field(default_factory=dict)
 
     def bump(self, name: str, by: int = 1) -> None:
         self.counters[name] = self.counters.get(name, 0) + by
 
     def record(self, phase: str, seconds: float) -> None:
         self.timings_s[phase] = self.timings_s.get(phase, 0.0) + seconds
+
+    def record_sub(self, phase: str, sub: str, seconds: float) -> None:
+        """Accumulate a sub-timing under ``phase`` (e.g. the fiat_shamir
+        phase splits into ``digest`` and ``rho``)."""
+        subs = self.subtimings_s.setdefault(phase, {})
+        subs[sub] = subs.get(sub, 0.0) + seconds
 
     @property
     def total_s(self) -> float:
@@ -49,6 +58,7 @@ class CeremonyTrace:
     def as_dict(self) -> dict:
         return {
             "timings_s": dict(self.timings_s),
+            "subtimings_s": {k: dict(v) for k, v in self.subtimings_s.items()},
             "total_s": self.total_s,
             "counters": dict(self.counters),
             "meta": dict(self.meta),

@@ -30,6 +30,15 @@ linter), so the committed baseline stays clean between CI runs:
         must use ``groups.device.encode_batch`` /
         ``crypto.chacha.chacha20_xor_batch`` so n^2 pairs cost one
         vectorized pass, not n^2 host calls (docs/perf.md)
+* DKG004  (dkg_tpu/dkg/ only) eager transcript-digest entry point
+        (``_compress_dev`` / ``_tree_from_words``) called from protocol
+        code — digests must go through ``device_hash.row_digests`` /
+        ``tree_digest`` so every call is jitted and backend-dispatched
+        (DKG_TPU_DIGEST); and, in the batch hot modules, a
+        ``hashlib.blake2b`` call lexically inside a loop — a per-dealer
+        hash loop is the O(n) host pathology ``crypto.blake2.
+        blake2b_batch`` exists to eliminate (host-oracle/audit legs:
+        ``_dealer_row_digests`` only; docs/perf.md)
 
 Exit 0 = clean.  Run: ``python scripts/lint_lite.py`` (from repo root).
 Also executed by tests/test_import_hygiene.py so the default test tier
@@ -89,6 +98,17 @@ _DEM_HOT_MODULES = {
 # tests diff the batch path against.
 _DEM_SCALAR_LEGS = {"seal_shares", "open_share"}
 
+# Eager transcript-digest entry points protocol code must not call
+# directly (DKG004): the public ``row_digests``/``tree_digest``
+# dispatchers are jitted and backend-dispatched (DKG_TPU_DIGEST); these
+# internals are neither.
+_DIGEST_EAGER_ENTRYPOINTS = {"_compress_dev", "_tree_from_words"}
+
+# Functions inside hot modules allowed to run hashlib.blake2b in a
+# loop (DKG004): the byte-level audit digest's per-dealer row hash —
+# the oracle the vectorized paths are diffed against.
+_DIGEST_HOST_LEGS = {"_dealer_row_digests"}
+
 
 class _Checker(ast.NodeVisitor):
     def __init__(self, path: pathlib.Path, tree: ast.Module, source: str):
@@ -99,6 +119,7 @@ class _Checker(ast.NodeVisitor):
         self.dunder_all: set[str] = set()
         self._source_lines = source.splitlines()
         self._func_stack: list[str] = []
+        self._loop_depth = 0
         self._net_module = "dkg_tpu/net/" in path.as_posix()
         self._dkg_module = "dkg_tpu/dkg/" in path.as_posix()
         self._dem_hot_module = (
@@ -211,6 +232,21 @@ class _Checker(ast.NodeVisitor):
         self.generic_visit(node)
         self._func_stack.pop()
 
+    def _visit_loop(self, node: ast.AST) -> None:
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    # loop tracking for DKG004: comprehensions count — a blake2b in a
+    # listcomp is the same per-dealer host loop spelled differently
+    visit_For = _visit_loop
+    visit_AsyncFor = _visit_loop
+    visit_While = _visit_loop
+    visit_ListComp = _visit_loop
+    visit_SetComp = _visit_loop
+    visit_DictComp = _visit_loop
+    visit_GeneratorExp = _visit_loop
+
     def visit_Call(self, node: ast.Call) -> None:
         # DKG001: net-layer decodes must route through the quarantine —
         # a raw decode_phase* call lets Byzantine bytes raise through
@@ -268,6 +304,42 @@ class _Checker(ast.NodeVisitor):
                     "groups.device.encode_batch / crypto.chacha."
                     "chacha20_xor_batch (scalar legs: seal_shares/"
                     "open_share only)",
+                )
+        # DKG004a: protocol code must use the jitted, backend-dispatched
+        # digest API (row_digests/tree_digest), never the eager
+        # device-tree internals.
+        if self._dkg_module:
+            func = node.func
+            name = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else ""
+            )
+            if name in _DIGEST_EAGER_ENTRYPOINTS:
+                self._add(
+                    node,
+                    "DKG004",
+                    f"{name}() in dkg/ — use device_hash.row_digests/"
+                    "tree_digest so the digest is jitted and "
+                    "backend-dispatched (DKG_TPU_DIGEST)",
+                )
+        # DKG004b: a hashlib.blake2b call lexically inside a loop in a
+        # batch hot module is a per-dealer host hash loop — use
+        # crypto.blake2.blake2b_batch (one array op for all n lanes).
+        if (
+            self._dem_hot_module
+            and self._loop_depth > 0
+            and not (set(self._func_stack) & _DIGEST_HOST_LEGS)
+        ):
+            func = node.func
+            name = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else ""
+            )
+            if name == "blake2b":
+                self._add(
+                    node,
+                    "DKG004",
+                    "hashlib.blake2b inside a loop in a dkg/ hot module — "
+                    "use crypto.blake2.blake2b_batch (host-oracle leg: "
+                    "_dealer_row_digests only)",
                 )
         self.generic_visit(node)
 

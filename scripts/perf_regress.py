@@ -5,9 +5,13 @@ bench's single JSON line under ``parsed`` (bench.py docstring).  This
 script diffs the NEWEST TWO rounds' headline metric
 (``share_verify_pairs_per_sec_per_chip``) and FAILS (exit 1) when the
 newer rate dropped more than 20% below the older one — the tripwire
-that catches a perf_opt PR quietly un-doing a previous one.  The
-dealing-phase metric (``config.pairs_sealed_per_s``, the vectorized
-KEM+DEM pipeline) is gated the same way when both rounds carry it.
+that catches a perf_opt PR quietly un-doing a previous one.  Three
+phase metrics are gated the same way when both rounds carry them: the
+dealing DEM rate (``config.pairs_sealed_per_s``, the vectorized
+KEM+DEM pipeline), the deal-phase pair rate
+(``config.rates_per_s.deal``), and the Fiat-Shamir pair rate
+(``config.rates_per_s.fiat_shamir`` — the jitted/host-dispatched
+transcript digest pipeline).
 
 Deliberately forgiving about everything except a real regression:
 
@@ -85,46 +89,53 @@ def main(argv: list[str] | None = None) -> int:
             "ran on different platforms — incomparable, skipping"
         )
         return 0
-    old_v, new_v = float(old["value"]), float(new["value"])
-    change = (new_v - old_v) / old_v
-    line = (
-        f"perf_regress: r{old_n} {old_v:.1f} -> r{new_n} {new_v:.1f} "
-        f"{new.get('unit', '')} ({change:+.1%}) on {new_plat}"
-    )
+    # every gated metric goes through one loop with one forgiveness
+    # rule: rounds predating a metric (or with that leg failed/zero)
+    # skip that gate with a note rather than blocking.
+    def _headline(parsed: dict):
+        return parsed.get("value")
+
+    def _cfg(key: str):
+        def get(parsed: dict):
+            return (parsed.get("config") or {}).get(key)
+
+        return get
+
+    def _rate(phase: str):
+        def get(parsed: dict):
+            rates = (parsed.get("config") or {}).get("rates_per_s")
+            return (rates or {}).get(phase)
+
+        return get
+
+    gates = [
+        ("headline", new.get("unit", ""), _headline),
+        ("dealing DEM", "pairs-sealed/s", _cfg("pairs_sealed_per_s")),
+        ("deal phase", "pairs/s", _rate("deal")),
+        ("fiat_shamir", "pairs/s", _rate("fiat_shamir")),
+    ]
     bad = 0
-    if change < -args.threshold:
-        print(f"{line} — REGRESSION beyond {args.threshold:.0%}", file=sys.stderr)
-        bad = 1
-    else:
-        print(line)
-    # dealing-phase gate: config.pairs_sealed_per_s (the vectorized
-    # KEM+DEM pipeline, bench.py docstring) — same forgiveness as the
-    # headline: rounds predating the metric (or with a failed seal leg)
-    # skip with a note rather than blocking.
-    old_d = (old.get("config") or {}).get("pairs_sealed_per_s")
-    new_d = (new.get("config") or {}).get("pairs_sealed_per_s")
-    if (
-        isinstance(old_d, (int, float)) and old_d > 0
-        and isinstance(new_d, (int, float)) and new_d > 0
-    ):
-        dchange = (new_d - old_d) / old_d
-        dline = (
-            f"perf_regress: dealing r{old_n} {old_d:.1f} -> r{new_n} "
-            f"{new_d:.1f} pairs-sealed/s ({dchange:+.1%}) on {new_plat}"
-        )
-        if dchange < -args.threshold:
+    for label, unit, extract in gates:
+        old_v, new_v = extract(old), extract(new)
+        if not (
+            isinstance(old_v, (int, float)) and old_v > 0
+            and isinstance(new_v, (int, float)) and new_v > 0
+        ):
             print(
-                f"{dline} — REGRESSION beyond {args.threshold:.0%}",
-                file=sys.stderr,
+                f"perf_regress: {label} metric absent in r{old_n} or "
+                f"r{new_n} — skipping this gate"
             )
+            continue
+        change = (new_v - old_v) / old_v
+        line = (
+            f"perf_regress: {label} r{old_n} {old_v:.1f} -> r{new_n} "
+            f"{new_v:.1f} {unit} ({change:+.1%}) on {new_plat}"
+        )
+        if change < -args.threshold:
+            print(f"{line} — REGRESSION beyond {args.threshold:.0%}", file=sys.stderr)
             bad = 1
         else:
-            print(dline)
-    else:
-        print(
-            f"perf_regress: pairs_sealed_per_s absent in r{old_n} or "
-            f"r{new_n} — skipping dealing gate"
-        )
+            print(line)
     return bad
 
 

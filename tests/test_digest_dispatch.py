@@ -1,0 +1,205 @@
+"""Backend dispatch of the transcript digest (DKG_TPU_DIGEST) and the
+vectorized Fiat-Shamir rho derivation.
+
+The dispatch contract: the jitted device Merkle tree and the numpy host
+batch are BIT-IDENTICAL — which leg runs is purely a performance
+choice, so the knob may never change a ceremony's rho.  Golden
+constants below were captured from the repo BEFORE the jit/dispatch/
+vectorization rewrite (eager device tree + per-dealer hashlib loop),
+pinning cross-version byte-identity, not just internal consistency.
+"""
+
+import hashlib
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dkg_tpu.crypto import device_hash as dh
+from dkg_tpu.dkg import ceremony as ce
+from dkg_tpu.fields import host as fh
+
+RNG = random.Random(0xD15B)
+
+# --- goldens from the pre-rewrite implementation (BatchedCeremony(
+# curve, n=4, t=1, b"golden", random.Random(0xD16)), deal_chunked,
+# transcript_digest_device hex / derive_rho(rho_bits=128) limb bytes)
+GOLDEN_DIGEST = {
+    "secp256k1": "6628ed68f5fef43054eb8cce6ce4cbe7e265c29df9bac397c2888b8041e75ac3",
+    "ristretto255": "0fbb51b1207c95865139fc055686f95f4a2f37588aaa8f4d772f678f4b204355",
+}
+GOLDEN_RHO = {
+    "secp256k1": (
+        "8f8d000075820000b94a0000bfc2000079ba000070a80000193300002e7d0000"
+        "0000000000000000000000000000000000000000000000000000000000000000"
+        "9f870000d0770000bb6f00001fd30000d59a00006829000004aa0000ae230000"
+        "0000000000000000000000000000000000000000000000000000000000000000"
+        "d8ec000023d30000f48b0000255f000026500000c448000054f60000a0090000"
+        "0000000000000000000000000000000000000000000000000000000000000000"
+        "1f9d0000d7520000c448000029ea0000a0d90000ca360000016300004b3d0000"
+        "0000000000000000000000000000000000000000000000000000000000000000"
+    ),
+    "ristretto255": (
+        "9f140000af3c0000e81b00002f8c000010be0000a6480000124000000bcd0000"
+        "0000000000000000000000000000000000000000000000000000000000000000"
+        "9081000058220000667c000080ae0000622a0000bdc50000e80a000050230000"
+        "0000000000000000000000000000000000000000000000000000000000000000"
+        "6a8d0000c7d700002737000067e50000b69c000009db000039010000104b0000"
+        "0000000000000000000000000000000000000000000000000000000000000000"
+        "2c3f0000912400000b3c0000f4530000660c0000a2e00000aa600000c19e0000"
+        "0000000000000000000000000000000000000000000000000000000000000000"
+    ),
+}
+
+
+# --- knob + dispatch resolution ---------------------------------------
+
+
+def test_digest_knob_rejects_bogus_value(monkeypatch):
+    monkeypatch.setenv("DKG_TPU_DIGEST", "gpu")
+    with pytest.raises(ValueError, match="DKG_TPU_DIGEST"):
+        dh.digest_dispatch()
+
+
+@pytest.mark.parametrize("val", [None, "auto"])
+def test_digest_auto_follows_backend(monkeypatch, val):
+    if val is None:
+        monkeypatch.delenv("DKG_TPU_DIGEST", raising=False)
+    else:
+        monkeypatch.setenv("DKG_TPU_DIGEST", val)
+    expect = "device" if jax.default_backend() == "tpu" else "host"
+    assert dh.digest_dispatch() == expect
+
+
+def test_digest_knob_forces_leg(monkeypatch):
+    for leg in ("device", "host"):
+        monkeypatch.setenv("DKG_TPU_DIGEST", leg)
+        assert dh.digest_dispatch() == leg
+
+
+# --- leg parity --------------------------------------------------------
+
+
+@pytest.mark.parametrize("rows,words", [(1, 7), (5, 40), (3, 2048)])
+def test_row_digests_legs_bit_identical(rows, words):
+    arr = np.asarray(
+        [[RNG.randrange(1 << 32) for _ in range(words)] for _ in range(rows)],
+        np.uint32,
+    )
+    dev = np.asarray(dh.row_digests(jnp.asarray(arr), domain=5, dispatch="device"))
+    host = dh.row_digests(arr, domain=5, dispatch="host")
+    np.testing.assert_array_equal(dev, np.asarray(host))
+
+
+def test_tree_digest_legs_bit_identical():
+    vals = np.asarray([RNG.randrange(1 << 32) for _ in range(333)], np.uint32)
+    dev = np.asarray(dh.tree_digest(jnp.asarray(vals), domain=11, dispatch="device"))
+    host = np.asarray(dh.tree_digest(vals, domain=11, dispatch="host"))
+    np.testing.assert_array_equal(dev, host)
+
+
+# --- ceremony-level goldens -------------------------------------------
+
+
+def _golden_ceremony(curve):
+    c = ce.BatchedCeremony(curve, 4, 1, b"golden", random.Random(0xD16))
+    return c, ce.deal_chunked(
+        c.cfg, c.coeffs_a, c.coeffs_b, c.g_table, c.h_table
+    )
+
+
+def _check_goldens(curve, monkeypatch):
+    c, (a, e, s, r) = _golden_ceremony(curve)
+    for leg in ("device", "host"):
+        monkeypatch.setenv("DKG_TPU_DIGEST", leg)
+        digest = ce.transcript_digest_device(c.cfg, a, e, s, r)
+        assert digest.hex() == GOLDEN_DIGEST[curve], leg
+        rho = ce.derive_rho(c.cfg, a, e, s, r, 128)
+        assert rho.tobytes().hex() == GOLDEN_RHO[curve], leg
+
+
+def test_transcript_and_rho_golden_secp256k1(monkeypatch):
+    """Both dispatch legs reproduce the pre-rewrite digest AND rho
+    byte-for-byte (acceptance criterion: the knob never changes a
+    ceremony's randomizers)."""
+    _check_goldens("secp256k1", monkeypatch)
+
+
+@pytest.mark.slow  # second curve = second deal compile; nightly tier
+def test_transcript_and_rho_golden_ristretto255(monkeypatch):
+    _check_goldens("ristretto255", monkeypatch)
+
+
+# --- vectorized fiat_shamir_rho ---------------------------------------
+
+
+def _rho_reference(cfg, transcript: bytes, rho_bits: int) -> np.ndarray:
+    """The pre-vectorization per-dealer hashlib loop, verbatim."""
+    fs = cfg.cs.scalar
+    nbytes = (rho_bits + 7) // 8
+    mask = (1 << rho_bits) - 1
+    out = np.zeros((cfg.n, fs.limbs), np.uint32)
+    for j in range(cfg.n):
+        h = hashlib.blake2b(
+            transcript + j.to_bytes(4, "little"),
+            digest_size=nbytes,
+            person=b"dkgtpu-rlc",
+        )
+        out[j] = fh.encode(fs, int.from_bytes(h.digest(), "little") & mask)
+    return out
+
+
+# 280 > the 256-bit scalar field: exercises the reduce-per-lane fallback
+@pytest.mark.parametrize("rho_bits", [8, 24, 64, 128, 255, 280])
+def test_fiat_shamir_rho_matches_scalar_loop(rho_bits):
+    cfg = ce.CeremonyConfig("secp256k1", 6, 2)
+    transcript = bytes(RNG.randrange(256) for _ in range(32))
+    got = ce.fiat_shamir_rho(cfg, transcript, rho_bits)
+    np.testing.assert_array_equal(got, _rho_reference(cfg, transcript, rho_bits))
+
+
+def test_fiat_shamir_rho_golden_128():
+    """Anchored constant (captured pre-rewrite): guards the reference
+    loop above and the batch path from drifting together."""
+    cfg = ce.CeremonyConfig("secp256k1", 6, 2)
+    got = ce.fiat_shamir_rho(cfg, bytes(range(32)), 128)
+    assert got.tobytes().hex() == (
+        "4ec60000d89f0000f1500000f3fa000002fe000092cc0000f6a6000030b20000"
+        "0000000000000000000000000000000000000000000000000000000000000000"
+        "9b580000d80e0000452d0000bdec000016680000a86800005d0900005c500000"
+        "0000000000000000000000000000000000000000000000000000000000000000"
+        "3f900000c7ca0000467d00008c0a00000a8900008494000019f50000b70f0000"
+        "0000000000000000000000000000000000000000000000000000000000000000"
+        "63fe00001f8a0000c5390000167200003ad3000078490000c7eb00007c680000"
+        "0000000000000000000000000000000000000000000000000000000000000000"
+        "cab90000a3da00009c8e00006f1e0000e1da0000bae30000a23d0000df9d0000"
+        "0000000000000000000000000000000000000000000000000000000000000000"
+        "e20d000068260000575f000026f3000035c70000fad00000c96600007b520000"
+        "0000000000000000000000000000000000000000000000000000000000000000"
+    )
+
+
+# --- host canonicalisation twin ---------------------------------------
+
+
+def test_affine_canon_host_matches_device():
+    """The host digest leg's big-int canonicalisation agrees limb-for-
+    limb with the jitted device one (identity lanes included)."""
+    from dkg_tpu.groups import device as gd
+
+    for curve in ("secp256k1", "ristretto255"):
+        cs = ce.CeremonyConfig(curve, 2, 1).cs
+        g = gd.generator(cs, (4,))
+        k = jnp.asarray(
+            fh.encode(cs.scalar, [3, 7, 1, 12345678901234567]), jnp.uint32
+        )
+        pts = gd.scalar_mul(cs, k, g)
+        # splice in an identity lane (zero Z) — canon must map it to the
+        # canonical identity encoding, not divide by zero
+        pts = jnp.concatenate([pts, gd.identity(cs, (1,))], axis=0)
+        dev = np.asarray(gd.affine_canon(cs, pts))
+        host = gd.affine_canon_host(cs, np.asarray(pts))
+        np.testing.assert_array_equal(dev, host, err_msg=curve)

@@ -289,3 +289,25 @@ def test_party_block_rejects_multi_axis_mesh():
     devs = np.asarray(jax.devices()).reshape(2, 4)
     with pytest.raises(ValueError, match="1-D"):
         multihost.process_party_block(16, Mesh(devs, ("replicas", "parties")))
+
+
+def test_sharded_transcript_digest_rejects_mixed_layout():
+    """Mixed dealer layouts (some tensors sharded, some replicated) must
+    raise a typed ValueError, not silently fold the wrong rows into the
+    digest (a wrong-but-valid rho is a soundness bug, and a bare assert
+    would vanish under ``python -O``)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    cfg = ce.CeremonyConfig("ristretto255", 8, 2)
+    mesh = pm.make_mesh(8)
+    sharded = NamedSharding(mesh, P(pm.PARTY_AXIS))
+    replicated = NamedSharding(mesh, P())
+    cs = cfg.cs
+    comm = jnp.zeros((cfg.n, cfg.t + 1, cs.ncoords, cs.field.limbs), jnp.uint32)
+    sh = jnp.zeros((cfg.n, cfg.n, cs.scalar.limbs), jnp.uint32)
+    a = jax.device_put(comm, sharded)
+    e = jax.device_put(comm, sharded)
+    s = jax.device_put(sh, replicated)  # the odd one out
+    r = jax.device_put(sh, sharded)
+    with pytest.raises(ValueError, match="dealer-axis layout"):
+        ce.sharded_transcript_digest(cfg, a, e, s, r)

@@ -34,6 +34,49 @@ def test_trace_rates_per_phase():
     assert rates == {"deal": 50.0, "verify": 200.0}
 
 
+def test_record_sub_accumulates_outside_phase_totals():
+    tr = CeremonyTrace()
+    tr.record("fiat_shamir", 1.0)
+    tr.record_sub("fiat_shamir", "digest", 0.25)
+    tr.record_sub("fiat_shamir", "digest", 0.25)
+    tr.record_sub("fiat_shamir", "rho", 0.125)
+    d = tr.as_dict()
+    assert d["subtimings_s"] == {"fiat_shamir": {"digest": 0.5, "rho": 0.125}}
+    # sub-timings never leak into timings_s: rates()/total_s must not
+    # double-count a phase
+    assert set(d["timings_s"]) == {"fiat_shamir"}
+    assert d["total_s"] == 1.0
+    json.loads(tr.json())  # serializable
+
+
+def test_derive_rho_records_digest_subtimings():
+    """derive_rho splits the fiat_shamir span into digest/rho sub-spans
+    and records which digest leg ran.  Identity-point commitment tensors
+    keep this in the cheap tier (no dealing compile)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dkg_tpu.groups import device as gd
+
+    cfg = ce.CeremonyConfig("ristretto255", 4, 1)
+    cs = cfg.cs
+    a = gd.identity(cs, (cfg.n, cfg.t + 1))
+    e = gd.identity(cs, (cfg.n, cfg.t + 1))
+    s = jnp.zeros((cfg.n, cfg.n, cs.scalar.limbs), jnp.uint32)
+    r = jnp.zeros((cfg.n, cfg.n, cs.scalar.limbs), jnp.uint32)
+    tr = CeremonyTrace()
+    rho = ce.derive_rho(cfg, a, e, s, r, 64, trace=tr)
+    assert rho.shape == (cfg.n, cs.scalar.limbs)
+    assert set(tr.subtimings_s["fiat_shamir"]) == {"digest", "rho"}
+    assert all(v >= 0 for v in tr.subtimings_s["fiat_shamir"].values())
+    assert tr.meta["digest_dispatch"] in ("device", "host")
+    # the audit (byte-level) digest family labels itself distinctly
+    tr2 = CeremonyTrace()
+    ce.derive_rho(cfg, np.asarray(a), np.asarray(e), np.asarray(s),
+                  np.asarray(r), 64, device=False, trace=tr2)
+    assert tr2.meta["digest_dispatch"] == "audit"
+
+
 def test_batched_dealing_traces_seal_phase():
     """Dealing traces split engine time (``deal``) from the KEM+DEM
     pipeline (``seal``) and count the pairs the seal span covered."""
@@ -75,3 +118,6 @@ def test_ceremony_run_with_trace():
         "builds", "disk_loads", "disk_rejects", "proc_hits"
     }
     assert tr.meta["n"] == 5 and tr.meta["curve"] == "ristretto255"
+    # the fiat_shamir phase carries its digest/rho split + dispatch leg
+    assert set(tr.subtimings_s["fiat_shamir"]) == {"digest", "rho"}
+    assert tr.meta["digest_dispatch"] in ("device", "host")
