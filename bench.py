@@ -793,6 +793,11 @@ def main():
                         "warm": res.get("warm"),
                         "table_stats": res.get("table_stats"),
                         "pallas": res["pallas"],
+                        # durable party checkpointing armed in the measured
+                        # environment (fsync'd WAL journaling changes wall
+                        # clock): rounds differing here are incomparable —
+                        # scripts/perf_regress.py skips the diff
+                        "checkpoint": bool(os.environ.get("DKG_TPU_CHECKPOINT_DIR")),
                         "flags": extra_env,  # {} == defaults
                         "tpu_cpu_bit_exact": parity,
                         "north_star": north_star,

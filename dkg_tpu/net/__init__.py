@@ -17,6 +17,9 @@ Robustness: transports are first-publish-wins with equivocation
 evidence, TcpHubChannel retries with capped backoff under DKG_TPU_NET_*
 knobs, run_party quarantines malformed peer bytes, and net.faults adds
 a deterministic fault-injection harness (docs/fault_model.md).
+net.checkpoint adds durable crash recovery: parties journal each round
+to a write-ahead log and ``run_party(..., checkpoint=...)`` resumes a
+restarted process mid-ceremony (docs/fault_model.md, "Crash recovery").
 """
 
 from .channel import (  # noqa: F401
@@ -28,5 +31,15 @@ from .channel import (  # noqa: F401
     TransportError,
     TruncatedStream,
 )
-from .faults import CrashFault, FaultPlan, FaultyChannel  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    PartyWal,
+    default_checkpoint_dir,
+    wal_path,
+)
+from .faults import (  # noqa: F401
+    CrashFault,
+    FaultPlan,
+    FaultyChannel,
+    RestartFault,
+)
 from .party import PartyResult, run_party  # noqa: F401

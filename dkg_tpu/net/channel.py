@@ -22,9 +22,15 @@ Robustness posture (see docs/fault_model.md):
   ``TcpHubChannel`` RPC retries transient socket failures under
   configurable attempt/timeout budgets (``DKG_TPU_NET_*`` knobs via
   utils.envknobs).
-* **Whole-ceremony fetch budget.**  ``TcpHubChannel`` can clamp every
-  fetch to the remainder of one ceremony-wide deadline instead of
-  paying a flat per-round timeout for each silent party.
+* **Whole-ceremony RPC budget.**  ``TcpHubChannel`` can clamp every
+  RPC — fetch waits, publish and evidence socket timeouts, retry
+  eligibility — to the remainder of one ceremony-wide deadline instead
+  of paying a flat per-round timeout for each silent party (or
+  attempts x io_timeout per RPC against a hung hub).
+* **Fail-fast hub frames.**  The hub answers unknown opcodes and
+  malformed/short frames with an explicit error byte and bounds frame
+  reads with a timeout, so a confused client fails immediately instead
+  of hanging until its socket deadline.
 
 ``TcpHub`` is a minimal length-prefixed TCP mailbox for multi-process
 ceremonies; authenticity/transport security is the deployment's job,
@@ -53,6 +59,20 @@ _EVIDENCE_CAP = 8
 
 # Ceiling for one backoff step, regardless of attempt count.
 _BACKOFF_CAP_S = 2.0
+
+# Socket-timeout floor for RPCs clamped by an exhausted ceremony budget:
+# a healthy local hub answers a publish in well under a second, so the
+# clamp bounds a hung hub's post-deadline cost without flaking working
+# publishes (which peers' drains depend on).
+_POST_BUDGET_IO_FLOOR_S = 1.0
+
+# How long the hub waits for the rest of a frame once a connection
+# opens; a well-behaved client sendall()s the whole frame before
+# reading, so anything slower is a stalled or malformed sender.
+_DEFAULT_FRAME_TIMEOUT_S = 5.0
+
+_ACK_OK = b"\x01"
+_ACK_ERR = b"\x00"
 
 # Defaults for the DKG_TPU_NET_* knobs (see docs/fault_model.md).
 _DEFAULT_IO_TIMEOUT_S = 60.0
@@ -136,12 +156,15 @@ class _HubHandler(socketserver.StreamRequestHandler):
     def handle(self) -> None:  # one request per connection
         hub: "TcpHub" = self.server.hub  # type: ignore[attr-defined]
         try:
+            # a sender that opens a connection but never completes its
+            # frame must not pin a handler thread forever
+            self.connection.settimeout(hub.frame_timeout_s)
             op = _read_exact(self.rfile, 1)[0]
             if op == _OP_PUB:
                 round_no, sender, ln = struct.unpack("<III", _read_exact(self.rfile, 12))
                 payload = _read_exact(self.rfile, ln)
                 hub.channel.publish(round_no, sender, payload)
-                self.wfile.write(b"\x01")
+                self.wfile.write(_ACK_OK)
             elif op == _OP_FETCH:
                 round_no, expected, timeout_ms = struct.unpack(
                     "<III", _read_exact(self.rfile, 12)
@@ -158,7 +181,20 @@ class _HubHandler(socketserver.StreamRequestHandler):
                 for (round_no, sender), payloads in sorted(ev.items()):
                     out.append(struct.pack("<III", round_no, sender, len(payloads)))
                 self.wfile.write(b"".join(out))
-        except (ConnectionError, TransportError):
+            else:
+                # unknown opcode: reply with an explicit error byte so
+                # the client fails NOW, not at its socket timeout
+                self.wfile.write(_ACK_ERR)
+        except (ConnectionError, TransportError, struct.error, OSError):
+            # malformed/short/stalled frame: best-effort error byte, then
+            # the connection closes — never a silent hang for the client
+            self._best_effort_error()
+
+    def _best_effort_error(self) -> None:
+        try:
+            self.wfile.write(_ACK_ERR)
+            self.wfile.flush()
+        except OSError:
             pass
 
 
@@ -172,13 +208,31 @@ def _read_exact(f, n: int) -> bytes:
     return buf
 
 
+def _read_ack(f) -> bytes:
+    """Read a one-byte hub ack; the explicit error byte (malformed or
+    unknown frame) is a retryable transport failure, not a success."""
+    ack = _read_exact(f, 1)
+    if ack != _ACK_OK:
+        raise TransportError(f"hub replied with error ack {ack!r}")
+    return ack
+
+
 class TcpHub:
     """The mailbox server: one per ceremony, any party (or a neutral
     host) can run it.  Threaded: each publish/fetch is one connection.
     First-publish-wins and the equivocation log come from the backing
-    :class:`InProcessChannel`."""
+    :class:`InProcessChannel`.  ``frame_timeout_s`` bounds how long a
+    handler waits for the rest of a frame once a connection opens —
+    stalled or malformed senders get an error byte, not a pinned
+    thread."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        frame_timeout_s: float = _DEFAULT_FRAME_TIMEOUT_S,
+    ) -> None:
+        self.frame_timeout_s = frame_timeout_s
         class _Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
@@ -212,12 +266,17 @@ class TcpHubChannel:
     * ``DKG_TPU_NET_TIMEOUT_S``  — per-RPC socket I/O timeout (default 60)
     * ``DKG_TPU_NET_ATTEMPTS``   — RPC attempts before giving up (default 4)
     * ``DKG_TPU_NET_BACKOFF_MS`` — base backoff between attempts (default 50)
-    * ``DKG_TPU_NET_BUDGET_S``   — whole-ceremony fetch budget (default off)
+    * ``DKG_TPU_NET_BUDGET_S``   — whole-ceremony RPC budget (default off)
 
     When the budget is set, the first operation arms one ceremony-wide
-    deadline and every subsequent ``fetch`` is clamped to the remaining
-    budget, so k silent parties cost one shared budget, not k full
-    per-round timeouts.
+    deadline and EVERY RPC is clamped to the remaining budget: each
+    ``fetch``'s hub-side wait shrinks to what is left (k silent parties
+    cost one shared budget, not k full per-round timeouts), and
+    ``publish``/``equivocation_counts`` socket timeouts are clamped too
+    (floored at ~1s so working publishes still land), with no retries
+    started past the deadline — a hung hub can no longer charge
+    attempts x io_timeout per RPC after the budget is spent.  Every
+    clamp is counted in ``stats["budget_clamps"]``.
     """
 
     def __init__(
@@ -272,16 +331,37 @@ class TcpHubChannel:
 
     # -- retrying RPC core --------------------------------------------------
 
-    def _rpc(self, payload: bytes, read_reply, io_timeout: float) -> object:
+    def _rpc(
+        self, payload: bytes, read_reply, io_timeout: float, budget_clamp: bool = True
+    ) -> object:
+        """One RPC with retries.  With ``budget_clamp`` (every RPC except
+        ``fetch``, which pre-clamps its hub-side wait itself) the
+        per-attempt socket timeout is clamped to the remaining ceremony
+        budget — a hung hub costs at most ~the floor per RPC after the
+        deadline, not attempts x io_timeout — and no RETRY starts past
+        the deadline (the first attempt always runs: peers' drains
+        depend on publishes landing even at the buzzer)."""
         self.stats["rpcs"] += 1
         last: Optional[Exception] = None
         for attempt in range(self._attempts):
+            remaining = self._budget_remaining()
             if attempt:
+                if remaining is not None and remaining <= 0.0:
+                    raise RetryBudgetExceeded(
+                        f"ceremony budget exhausted after {attempt} attempt(s) "
+                        f"to {self._addr}: {last!r}"
+                    )
                 self.stats["retries"] += 1
                 step = min(_BACKOFF_CAP_S, self._backoff_s * (2 ** (attempt - 1)))
                 time.sleep(step * (0.5 + self._rng.random()))
+            timeout = io_timeout
+            if budget_clamp and remaining is not None:
+                clamped = min(io_timeout, max(remaining, _POST_BUDGET_IO_FLOOR_S))
+                if clamped < timeout:
+                    self.stats["budget_clamps"] += 1
+                    timeout = clamped
             try:
-                with socket.create_connection(self._addr, timeout=io_timeout) as s:
+                with socket.create_connection(self._addr, timeout=timeout) as s:
                     s.sendall(payload)
                     f = s.makefile("rb")
                     return read_reply(f)
@@ -292,9 +372,8 @@ class TcpHubChannel:
         )
 
     def publish(self, round_no: int, sender: int, payload: bytes) -> None:
-        self._budget_remaining()  # arm the ceremony deadline
         msg = bytes([_OP_PUB]) + struct.pack("<III", round_no, sender, len(payload)) + payload
-        self._rpc(msg, lambda f: _read_exact(f, 1), self._io_timeout_s)
+        self._rpc(msg, _read_ack, self._io_timeout_s)
 
     def fetch(self, round_no: int, expected: int, timeout: float = 30.0) -> dict[int, bytes]:
         remaining = self._budget_remaining()
@@ -313,8 +392,10 @@ class TcpHubChannel:
             return out
 
         # The hub blocks up to ``timeout`` before replying, so the socket
-        # deadline must cover the wait *plus* normal I/O slack.
-        return self._rpc(msg, read_reply, timeout + self._io_timeout_s)
+        # deadline must cover the wait *plus* normal I/O slack; the hub
+        # wait was already clamped (and counted) above, so _rpc must not
+        # clamp — or double-count — again.
+        return self._rpc(msg, read_reply, timeout + self._io_timeout_s, budget_clamp=False)
 
     def equivocation_counts(self) -> dict[tuple[int, int], int]:
         """(round, sender) -> number of distinct payloads the hub saw

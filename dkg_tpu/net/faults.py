@@ -31,6 +31,15 @@ Fault vocabulary (all scheduled per (round, sender)):
                    before any operation on a later round
                    (:class:`CrashFault` propagates out of run_party,
                    modelling a process crash).
+* ``restart``    — the party dies mid-round (after publishing, while
+                   fetching) and, when ``run_with_faults`` was given a
+                   ``checkpoint_dir``, is re-spawned from its WAL with a
+                   FRESH rng — recovery must depend only on the durable
+                   checkpoint, never on replaying the random stream
+                   (:class:`RestartFault`; net/checkpoint.py).  Without
+                   a checkpoint_dir the restart is a terminal crash, so
+                   the same schedule exercises the dropout/
+                   reconstruction path instead.
 """
 
 from __future__ import annotations
@@ -41,6 +50,7 @@ import time
 from typing import Callable, Optional
 
 from .channel import BroadcastChannel
+from .checkpoint import wal_path
 from .party import PartyResult, run_party
 
 _KIND_CODES = {
@@ -59,6 +69,11 @@ class CrashFault(RuntimeError):
     """Simulated process crash of one party (not a protocol error)."""
 
 
+class RestartFault(CrashFault):
+    """A crash the harness may recover from: the party died mid-round
+    and should be re-spawned from its checkpoint WAL."""
+
+
 class FaultPlan:
     """A seeded, replayable schedule of wire faults for one ceremony.
 
@@ -75,6 +90,10 @@ class FaultPlan:
         # (round, sender) -> [(kind, arg), ...] in scheduling order
         self._faults: dict[tuple[int, int], list[tuple[str, object]]] = {}
         self._crash_after: dict[int, int] = {}  # sender -> last completed round
+        self._restarts: dict[int, set[int]] = {}  # sender -> rounds it dies in
+        # (sender, round) restarts already fired: each scheduled restart
+        # kills exactly one incarnation, else respawn would loop forever
+        self._restarts_fired: set[tuple[int, int]] = set()
 
     # -- builders -----------------------------------------------------------
 
@@ -116,6 +135,15 @@ class FaultPlan:
         )
         return self
 
+    def restart(self, sender: int, round_no: int) -> "FaultPlan":
+        """Party ``sender`` dies mid-round ``round_no`` — after its
+        publish landed, while fetching the round — raising
+        :class:`RestartFault` exactly once per scheduled (sender, round).
+        ``run_with_faults(checkpoint_dir=...)`` re-spawns the party from
+        its WAL; without a checkpoint_dir the restart is terminal."""
+        self._restarts.setdefault(sender, set()).add(round_no)
+        return self
+
     # -- queries ------------------------------------------------------------
 
     def faults_for(self, round_no: int, sender: int) -> list[tuple[str, object]]:
@@ -124,6 +152,22 @@ class FaultPlan:
     def crashes_at(self, sender: int, round_no: int) -> bool:
         last_ok = self._crash_after.get(sender)
         return last_ok is not None and round_no > last_ok
+
+    def check_restart(self, sender: int, round_no: int) -> None:
+        """Raise :class:`RestartFault` if a restart is scheduled here and
+        has not fired yet (fire-once: later incarnations pass through)."""
+        if round_no in self._restarts.get(sender, ()):
+            key = (sender, round_no)
+            if key not in self._restarts_fired:
+                self._restarts_fired.add(key)
+                raise RestartFault(
+                    f"party {sender} restarted during round {round_no}"
+                )
+
+    def reset_runtime(self) -> None:
+        """Forget fired restarts so the same plan object replays
+        identically on a second ceremony (run_with_faults calls this)."""
+        self._restarts_fired.clear()
 
     def as_dict(self) -> dict:
         """JSON-able description (for CHAOS.json / failure reports)."""
@@ -141,6 +185,9 @@ class FaultPlan:
             ],
             # string keys so the dict round-trips through JSON unchanged
             "crash_after": {str(s): r for s, r in sorted(self._crash_after.items())},
+            "restarts": {
+                str(s): sorted(rs) for s, rs in sorted(self._restarts.items())
+            },
         }
 
     # -- deterministic mutation helpers -------------------------------------
@@ -227,6 +274,10 @@ class FaultyChannel:
 
     def fetch(self, round_no: int, expected: int, timeout: float = 30.0) -> dict[int, bytes]:
         self._check_crash(round_no)
+        # a restart strikes mid-round: the publish already landed (and,
+        # with checkpointing, its WAL record is durable), the fetch never
+        # completes — the classic crash window recovery must cover
+        self._plan.check_restart(self._party, round_no)
         return self._inner.fetch(round_no, expected, timeout)
 
     def __getattr__(self, name: str):
@@ -261,6 +312,7 @@ def run_with_faults(
     timeout: float = 5.0,
     seed: int = 0,
     join_timeout: float = 300.0,
+    checkpoint_dir: Optional[str] = None,
 ):
     """Run a full threaded ceremony with ``plan`` applied to every party.
 
@@ -269,20 +321,47 @@ def run_with_faults(
     Returns a list of per-party outcomes: :class:`PartyResult`, a
     :class:`CrashFault` for crashed parties, or the raised exception if
     a party died for any other reason (a harness bug, never expected).
+
+    With ``checkpoint_dir`` set, every party journals to a WAL under it
+    and a :class:`RestartFault` re-spawns the party from that WAL with a
+    FRESH rng (seed mixed with the incarnation count) — proving recovery
+    depends only on the durable checkpoint, not the random stream.
+    Without it, restart faults are terminal crashes, so the identical
+    schedule exercises today's dropout/reconstruction path instead.
     """
     n = env.nr_members
     results: list[object] = [None] * n
+    plan.reset_runtime()
 
     def worker(i: int) -> None:
-        chan = FaultyChannel(channel_factory(i), plan, party=i + 1)
-        try:
-            results[i] = run_party(
-                chan, env, keys[i], pks, i + 1, random.Random(seed * 6151 + i), timeout=timeout
+        incarnation = 0
+        while True:
+            chan = FaultyChannel(channel_factory(i), plan, party=i + 1)
+            wal = (
+                wal_path(checkpoint_dir, i + 1) if checkpoint_dir is not None else None
             )
-        except CrashFault as cf:
-            results[i] = cf
-        except Exception as exc:  # noqa: BLE001 — surfaced to the caller verbatim
-            results[i] = exc
+            rng = random.Random(seed * 6151 + i + incarnation * 7919)
+            try:
+                res = run_party(
+                    chan, env, keys[i], pks, i + 1, rng,
+                    timeout=timeout, checkpoint=wal,
+                )
+                # run_party reports resumes=1 for any resumed incarnation;
+                # the harness knows the true respawn count
+                res.resumes = max(res.resumes, incarnation)
+                results[i] = res
+                return
+            except RestartFault as rf:
+                if checkpoint_dir is None:
+                    results[i] = rf  # no WAL: a restart is a terminal crash
+                    return
+                incarnation += 1
+            except CrashFault as cf:
+                results[i] = cf
+                return
+            except Exception as exc:  # noqa: BLE001 — surfaced to the caller verbatim
+                results[i] = exc
+                return
 
     threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
     for th in threads:
@@ -295,7 +374,11 @@ def run_with_faults(
 def honest_results(results, plan: FaultPlan) -> list[PartyResult]:
     """The PartyResults of parties the plan never touched (1-based
     untouched indices), in index order."""
-    touched = {s for (_, s) in plan._faults} | set(plan._crash_after)
+    touched = (
+        {s for (_, s) in plan._faults}
+        | set(plan._crash_after)
+        | set(plan._restarts)
+    )
     return [
         r
         for i, r in enumerate(results)

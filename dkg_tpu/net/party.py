@@ -20,6 +20,21 @@ retries) and threads the counters into utils.tracing.
 A party that hits a protocol-fatal error still publishes its complaint
 evidence first (reference: committee.rs:340-347) and then publishes
 empty payloads for the remaining rounds so peers never block on it.
+
+Crash recovery: the ceremony is structured as resumable per-round
+steps.  Each round r splits into a *head* (state transition, WAL
+record, publish) and a *tail* (fetch + decode of round r).  With
+``run_party(..., checkpoint=path)`` every head appends one durable
+record to a :class:`~dkg_tpu.net.checkpoint.PartyWal` **before** its
+publish — rounds 1–2 consume ``rng``, so a recomputed round would
+publish different bytes (equivocation under first-publish-wins); the
+write-ahead ordering guarantees published bytes are always durable and
+recomputed rounds were never published.  A restarted process replays
+the log, re-publishes the recorded rounds (idempotent: the channel
+keeps the first publish), re-fetches closed rounds from the retained
+mailboxes, and continues live from the first unfinished round — same
+master key, zero consumed fault budget (docs/fault_model.md, "Crash
+recovery").
 """
 
 from __future__ import annotations
@@ -47,6 +62,7 @@ from ..dkg.procedure_keys import (
 from ..utils import serde
 from ..utils.tracing import CeremonyTrace, phase_span
 from .channel import BroadcastChannel
+from .checkpoint import PartyWal
 
 
 @dataclass
@@ -59,6 +75,9 @@ class PartyResult:
     quarantined: int = 0  # peer messages that failed decode/validation
     timeouts: int = 0  # rounds that closed before all n messages arrived
     retries: int = 0  # channel RPC retries (channels exposing .stats)
+    resumes: int = 0  # times this party resumed from its checkpoint WAL
+    wal_records: int = 0  # WAL records at completion (replayed + appended)
+    replayed_rounds: int = 0  # rounds restored from the WAL at start
     trace: Optional[CeremonyTrace] = field(default=None, repr=False)
 
     @property
@@ -105,6 +124,37 @@ def _valid_phase5(b, n: int) -> bool:
     )
 
 
+def _valid_any(b, n: int) -> bool:
+    return True
+
+
+# Per-round wire handling: decoder, validator, and the Fetched* wrapper
+# the committee state machine consumes.
+_ROUNDS = {
+    1: (serde.decode_phase1, _valid_phase1,
+        lambda env, j, b: FetchedPhase1.from_broadcast(env, j, b)),
+    2: (serde.decode_phase2, _valid_phase2,
+        lambda env, j, b: FetchedComplaints2(j, b)),
+    3: (serde.decode_phase3, _valid_any,
+        lambda env, j, b: FetchedPhase3.from_broadcast(env, j, b)),
+    4: (serde.decode_phase4, _valid_phase4,
+        lambda env, j, b: FetchedComplaints4(j, b)),
+    5: (serde.decode_phase5, _valid_phase5,
+        lambda env, j, b: FetchedPhase5(j, b)),
+}
+
+
+@dataclass(frozen=True)
+class _FetchOutcome:
+    """What one round's fetch+decode observed — recorded in the NEXT
+    round's WAL record so a resumed party restores its counters and can
+    reconstruct the exact decode view (present mask) it acted on."""
+
+    present: tuple[int, ...]
+    quarantined_delta: int
+    timed_out: bool
+
+
 def _publish(channel, round_no: int, my: int, payload: Optional[bytes]) -> None:
     channel.publish(round_no, my, payload or b"")
 
@@ -116,6 +166,263 @@ def _drain(channel, my: int, start_round: int, result: PartyResult) -> PartyResu
     return result
 
 
+class _PartyRun:
+    """One incarnation of one party: per-round head/tail steps over a
+    channel, optionally journaled to (and resumed from) a PartyWal."""
+
+    def __init__(self, channel, env, comm_key, pks, my, rng, timeout, trace, wal):
+        self.channel = channel
+        self.env = env
+        self.group = env.group
+        self.n = env.nr_members
+        self.comm_key = comm_key
+        self.pks = pks
+        self.my = my
+        self.rng = rng
+        self.timeout = timeout
+        self.trace = trace
+        self.wal = wal
+        self.others = [j for j in range(1, self.n + 1) if j != my]
+        self.result = PartyResult(my, trace=trace)
+        self.phase = None  # DkgPhase* driving the next transition
+        self.fetched1 = None  # round-1 broadcasts (re-consumed by round 3)
+        self.prev = None  # decoded messages the next head consumes
+        self.last_outcome: Optional[_FetchOutcome] = None
+        self.finished = False
+
+    # -- shared plumbing ----------------------------------------------------
+
+    def _decode_list(self, round_no: int, got: dict[int, bytes], counting: bool):
+        decoder, validate, wrap = _ROUNDS[round_no]
+        out = []
+        for j in self.others:
+            payload = got.get(j)
+            b = None
+            if payload:  # absent or explicit empty: silent disqualification
+                b = _decode_quarantined(decoder, self.group, payload)
+                if b is not None and not validate(b, self.n):
+                    b = None
+                if b is None and counting:
+                    self.result.quarantined += 1
+            out.append(wrap(self.env, j, b))
+        return out
+
+    def _tail(self, round_no: int):
+        """Fetch + decode round ``round_no``; records the outcome for the
+        next head's WAL record."""
+        got = self.channel.fetch(round_no, self.n, self.timeout)
+        timed_out = len(got) < self.n
+        if timed_out:
+            self.result.timeouts += 1
+        q0 = self.result.quarantined
+        lst = self._decode_list(round_no, got, counting=True)
+        self.last_outcome = _FetchOutcome(
+            tuple(sorted(got)), self.result.quarantined - q0, timed_out
+        )
+        if round_no == 1:
+            self.fetched1 = lst
+        self.prev = lst
+
+    def _record(self, round_no: int, payload: bytes, phase=None,
+                error=None, drain_from: int = 0) -> None:
+        """Append round ``round_no``'s WAL record.  MUST run before the
+        round's publish: the write-ahead ordering is what makes resumed
+        re-publishes byte-identical (module docstring)."""
+        if self.wal is None:
+            return
+        o = self.last_outcome
+        body = serde.encode_round_record(
+            self.group, round_no, payload, phase,
+            error=error, drain_from=drain_from,
+            present=o.present if o else None,
+            quarantined_delta=o.quarantined_delta if o else 0,
+            timed_out=o.timed_out if o else False,
+        )
+        self.wal.append(body)
+        self.result.wal_records += 1
+
+    def _abort(self, err: DkgError, drain_from: int) -> None:
+        self.result.error = err
+        _drain(self.channel, self.my, drain_from, self.result)
+        self.finished = True
+
+    def _finish(self) -> PartyResult:
+        res = self.result
+        stats = getattr(self.channel, "stats", None)
+        if isinstance(stats, dict):
+            res.retries = int(stats.get("retries", 0))
+        if self.trace is not None:
+            self.trace.bump("net.quarantined", res.quarantined)
+            self.trace.bump("net.round_timeouts", res.timeouts)
+            self.trace.bump("net.rpc_retries", res.retries)
+            self.trace.bump("net.resumes", res.resumes)
+            self.trace.bump("wal.records", res.wal_records)
+            self.trace.bump("wal.replayed_rounds", res.replayed_rounds)
+            self.trace.meta.setdefault("party_index", self.my)
+        return res
+
+    # -- per-round heads (transition, record, publish) ----------------------
+
+    def _head1(self) -> None:
+        phase1, b1 = DistributedKeyGeneration.init(
+            self.env, self.rng, self.comm_key, self.pks, self.my
+        )
+        p1 = serde.encode_phase1(self.group, b1)
+        self._record(1, p1, phase=phase1)
+        _publish(self.channel, 1, self.my, p1)
+        self.phase = phase1
+
+    def _head2(self) -> None:
+        nxt, b2 = self.phase.proceed(self.fetched1, self.rng)
+        p2 = serde.encode_phase2(self.group, b2) if b2 else b""
+        if isinstance(nxt, DkgError):
+            # complaint evidence is committed bytes too: pin it in a
+            # terminal record before publishing (crash mid-drain must
+            # not recompute the proofs with a fresh rng)
+            self._record(2, p2, error=nxt, drain_from=3)
+            _publish(self.channel, 2, self.my, p2)
+            self._abort(nxt, 3)
+            return
+        self._record(2, p2, phase=nxt)
+        _publish(self.channel, 2, self.my, p2)
+        self.phase = nxt
+
+    def _head3(self) -> None:
+        nxt, b3 = self.phase.proceed(self.prev, self.fetched1)
+        if isinstance(nxt, DkgError):
+            self._record(3, b"", error=nxt, drain_from=3)
+            self._abort(nxt, 3)
+            return
+        p3 = serde.encode_phase3(self.group, b3) if b3 else b""
+        self._record(3, p3, phase=nxt)
+        _publish(self.channel, 3, self.my, p3)
+        self.phase = nxt
+
+    def _head4(self) -> None:
+        nxt, b4 = self.phase.proceed(self.prev)
+        p4 = serde.encode_phase4(self.group, b4) if b4 else b""
+        if isinstance(nxt, DkgError):
+            self._record(4, p4, error=nxt, drain_from=5)
+            _publish(self.channel, 4, self.my, p4)
+            self._abort(nxt, 5)
+            return
+        self._record(4, p4, phase=nxt)
+        _publish(self.channel, 4, self.my, p4)
+        self.phase = nxt
+
+    def _head5(self) -> None:
+        nxt, b5 = self.phase.proceed(self.prev)
+        p5 = serde.encode_phase5(self.group, b5) if b5 else b""
+        if isinstance(nxt, DkgError):
+            self._record(5, p5, error=nxt, drain_from=6)
+            _publish(self.channel, 5, self.my, p5)
+            self._abort(nxt, 6)
+            return
+        self._record(5, p5, phase=nxt)
+        _publish(self.channel, 5, self.my, p5)
+        self.phase = nxt
+
+    def _finalise(self) -> None:
+        out, _ = self.phase.finalise(self.prev)
+        if isinstance(out, DkgError):
+            self.result.error = out
+        else:
+            self.result.master, self.result.share = out
+        self.finished = True
+
+    _HEADS = {1: _head1, 2: _head2, 3: _head3, 4: _head4, 5: _head5}
+
+    # -- resume -------------------------------------------------------------
+
+    def _replay_records(self):
+        """Intact, contiguous WAL records 1..R (a terminal record, if
+        any, is last) plus their raw bodies.  Anything after the first
+        gap/corruption is a torn tail and is discarded — resume falls
+        back to the previous round, which the write-ahead ordering
+        makes safe."""
+        records, bodies = [], []
+        for body in self.wal.replay():
+            try:
+                rec = serde.decode_round_record(self.group, body)
+            except ValueError:
+                break
+            if rec.round_no != len(records) + 1:
+                break
+            records.append(rec)
+            bodies.append(body)
+            if rec.error is not None:
+                break
+        return records, bodies
+
+    def _rebuild_fetched1(self, rec2) -> None:
+        """Round 3 re-consumes the round-1 broadcasts; rebuild them from
+        the retained mailbox filtered to the recorded present mask (late
+        stragglers must not change the replayed view).  Decode failures
+        were already counted in the record's quarantined_delta."""
+        present = rec2.present or ()
+        got = self.channel.fetch(1, len(present), self.timeout)
+        got = {j: got[j] for j in present if j in got}
+        self.fetched1 = self._decode_list(1, got, counting=False)
+
+    def _resume(self) -> int:
+        """Replay the WAL; returns the last recorded round R (0 = start
+        fresh).  On return the run continues at round R's tail."""
+        records, bodies = self._replay_records()
+        if not records:
+            # a log that exists but replays to nothing is unusable —
+            # recreate it so fresh records don't land after garbage, and
+            # run from round 1 (dropout semantics if the ceremony moved on)
+            self.wal.reset()
+            return 0
+        # compact away any torn tail before appending new records: bytes
+        # from a half-written frame would shadow everything after them
+        # on the next replay (the double-crash case)
+        self.wal.rewrite(bodies)
+        with phase_span(self.trace, "net_resume", annotate_device=False):
+            res = self.result
+            res.resumes = 1
+            res.replayed_rounds = len(records)
+            res.wal_records = len(records)
+            for rec in records:
+                if rec.present is not None:
+                    res.quarantined += rec.quarantined_delta
+                    if rec.timed_out:
+                        res.timeouts += 1
+            # re-publish every recorded round: first-publish-wins makes
+            # this an idempotent no-op for rounds that already landed,
+            # and delivers the exact recorded bytes for a publish the
+            # crash interrupted
+            for rec in records:
+                _publish(self.channel, rec.round_no, self.my, rec.payload)
+            last = records[-1]
+            if last.error is not None:
+                self._abort(last.error, last.drain_from)
+                return last.round_no
+            self.phase = last.phase
+            if last.round_no == 2:
+                self._rebuild_fetched1(records[1])
+        return last.round_no
+
+    # -- driver -------------------------------------------------------------
+
+    def execute(self) -> PartyResult:
+        resume_round = 0
+        if self.wal is not None:
+            resume_round = self._resume()
+        if self.finished:
+            return self._finish()
+        for r in range(max(1, resume_round), 6):
+            with phase_span(self.trace, f"net_round{r}", annotate_device=False):
+                if r != resume_round:
+                    self._HEADS[r](self)
+                    if self.finished:
+                        return self._finish()
+                self._tail(r)
+                if r == 5:
+                    self._finalise()
+        return self._finish()
+
+
 def run_party(
     channel: BroadcastChannel,
     env: Environment,
@@ -125,6 +432,7 @@ def run_party(
     rng,
     timeout: float = 30.0,
     trace: Optional[CeremonyTrace] = None,
+    checkpoint: Optional[object] = None,
 ) -> PartyResult:
     """Execute one party's side of the ceremony over ``channel``.
 
@@ -133,109 +441,16 @@ def run_party(
     this party's secret share on success.  Pass a
     :class:`~dkg_tpu.utils.tracing.CeremonyTrace` to collect per-round
     wall-clock and the quarantine/timeout/retry counters.
+
+    ``checkpoint`` (a path or :class:`~dkg_tpu.net.checkpoint.PartyWal`)
+    enables durable crash recovery: protocol state is journaled before
+    every publish, and a restarted process pointed at the same WAL
+    resumes from the first unfinished round with the byte-identical
+    outcome (module docstring; docs/fault_model.md, "Crash recovery").
     """
-    group = env.group
-    n = env.nr_members
-    others = [j for j in range(1, n + 1) if j != my]
-    result = PartyResult(my, trace=trace)
-
-    def fetch(round_no: int) -> dict[int, bytes]:
-        got = channel.fetch(round_no, n, timeout)
-        if len(got) < n:
-            result.timeouts += 1
-        return got
-
-    def decoded(got: dict[int, bytes], j: int, decoder, validate):
-        payload = got.get(j)
-        if not payload:
-            return None  # absent or explicit empty: silent disqualification
-        b = _decode_quarantined(decoder, group, payload)
-        if b is not None and not validate(b, n):
-            b = None
-        if b is None:
-            result.quarantined += 1
-        return b
-
-    def finish(res: PartyResult) -> PartyResult:
-        stats = getattr(channel, "stats", None)
-        if isinstance(stats, dict):
-            res.retries = int(stats.get("retries", 0))
-        if trace is not None:
-            trace.bump("net.quarantined", res.quarantined)
-            trace.bump("net.round_timeouts", res.timeouts)
-            trace.bump("net.rpc_retries", res.retries)
-            trace.meta.setdefault("party_index", my)
-        return res
-
-    # ---- round 1: dealing ------------------------------------------------
-    with phase_span(trace, "net_round1", annotate_device=False):
-        phase1, b1 = DistributedKeyGeneration.init(env, rng, comm_key, committee_pks, my)
-        _publish(channel, 1, my, serde.encode_phase1(group, b1))
-        got1 = fetch(1)
-        fetched1 = [
-            FetchedPhase1.from_broadcast(
-                env, j, decoded(got1, j, serde.decode_phase1, _valid_phase1)
-            )
-            for j in others
-        ]
-
-    # ---- round 2: share verification + complaints ------------------------
-    with phase_span(trace, "net_round2", annotate_device=False):
-        nxt, b2 = phase1.proceed(fetched1, rng)
-        _publish(channel, 2, my, serde.encode_phase2(group, b2) if b2 else None)
-        if isinstance(nxt, DkgError):
-            result.error = nxt
-            return finish(_drain(channel, my, 3, result))
-        got2 = fetch(2)
-        complaints2 = [
-            FetchedComplaints2(j, decoded(got2, j, serde.decode_phase2, _valid_phase2))
-            for j in others
-        ]
-
-    # ---- round 3: qualified set + bare commitments -----------------------
-    with phase_span(trace, "net_round3", annotate_device=False):
-        nxt, b3 = nxt.proceed(complaints2, fetched1)
-        if isinstance(nxt, DkgError):
-            result.error = nxt
-            return finish(_drain(channel, my, 3, result))
-        _publish(channel, 3, my, serde.encode_phase3(group, b3) if b3 else None)
-        got3 = fetch(3)
-        fetched3 = [
-            FetchedPhase3.from_broadcast(
-                env, j, decoded(got3, j, serde.decode_phase3, lambda b, n: True)
-            )
-            for j in others
-        ]
-
-    # ---- round 4: re-verification + disclosure complaints ----------------
-    with phase_span(trace, "net_round4", annotate_device=False):
-        nxt, b4 = nxt.proceed(fetched3)
-        _publish(channel, 4, my, serde.encode_phase4(group, b4) if b4 else None)
-        if isinstance(nxt, DkgError):
-            result.error = nxt
-            return finish(_drain(channel, my, 5, result))
-        got4 = fetch(4)
-        complaints4 = [
-            FetchedComplaints4(j, decoded(got4, j, serde.decode_phase4, _valid_phase4))
-            for j in others
-        ]
-
-    # ---- round 5: adjudication + share disclosure ------------------------
-    with phase_span(trace, "net_round5", annotate_device=False):
-        nxt, b5 = nxt.proceed(complaints4)
-        _publish(channel, 5, my, serde.encode_phase5(group, b5) if b5 else None)
-        if isinstance(nxt, DkgError):
-            result.error = nxt
-            return finish(result)
-        got5 = fetch(5)
-        fetched5 = [
-            FetchedPhase5(j, decoded(got5, j, serde.decode_phase5, _valid_phase5))
-            for j in others
-        ]
-
-        out, _ = nxt.finalise(fetched5)
-    if isinstance(out, DkgError):
-        result.error = out
-        return finish(result)
-    result.master, result.share = out
-    return finish(result)
+    wal = None
+    if checkpoint is not None:
+        wal = checkpoint if isinstance(checkpoint, PartyWal) else PartyWal(checkpoint)
+    return _PartyRun(
+        channel, env, comm_key, committee_pks, my, rng, timeout, trace, wal
+    ).execute()

@@ -11,6 +11,7 @@ DKG_TPU_ED_FUSED_LADDER / DKG_TPU_ED_FUSED_DOUBLES via groups.device,
 DKG_TPU_PALLAS / DKG_TPU_ASSUME_BACKEND via fields.device,
 DKG_TPU_MXU via fields.matmul, DKG_TPU_TABLE_CACHE via
 groups.precompute, DKG_TPU_NET_* transport knobs via net.channel,
+DKG_TPU_CHECKPOINT_DIR via net.checkpoint,
 DKG_TPU_DIGEST via crypto.device_hash.digest_dispatch).
 
 An EMPTY value is everywhere treated as unset: ``DKG_TPU_X= cmd`` is

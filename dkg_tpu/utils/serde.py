@@ -15,6 +15,7 @@ pickle — decoding untrusted bytes must never execute anything.
 from __future__ import annotations
 
 import struct
+from dataclasses import dataclass
 from typing import Optional
 
 from ..crypto.correct_decryption import CorrectHybridDecrKeyZkp
@@ -22,7 +23,7 @@ from ..crypto.dleq import DleqZkp
 from ..crypto.elgamal import HybridCiphertext, Keypair, SymmetricKey
 from ..dkg import broadcast as bc
 from ..dkg import committee as cm
-from ..dkg.errors import DkgErrorKind
+from ..dkg.errors import DkgError, DkgErrorKind
 from ..dkg.procedure_keys import MemberCommunicationKey, MemberCommunicationPublicKey
 from ..groups.host import HostGroup
 
@@ -382,3 +383,119 @@ def restore(group: HostGroup, data: bytes):
         st.public_share = group.scalar_mul(st.final_share, group.generator())
     r.done()
     return _PHASES[name](st)
+
+
+# ---------------------------------------------------------------------------
+# WAL round records (net.checkpoint — durable crash recovery)
+# ---------------------------------------------------------------------------
+
+RECORD_MAGIC = b"DKGR"
+
+# Record kinds: a *state* record snapshots the phase object that drives
+# the next round; a *terminal* record pins an error-path publish (e.g.
+# complaint evidence broadcast alongside a DkgError) so a crash during
+# the drain can never recompute — and equivocate on — committed bytes.
+_REC_STATE = 1
+_REC_TERMINAL = 2
+
+
+@dataclass(frozen=True)
+class RoundRecord:
+    """One replayed WAL record (see net.checkpoint / net.party).
+
+    ``payload`` is the exact wire bytes published for ``round_no``
+    (possibly empty).  State records carry ``phase`` (the restored
+    DkgPhase* for the next round); terminal records carry ``error`` and
+    ``drain_from`` instead.  ``present`` is the sender set observed in
+    ``fetch(round_no - 1)`` (None for round 1): re-decoding those same
+    mailbox entries is deterministic, so the mask alone reconstructs the
+    original decode view even if stragglers landed later.
+    """
+
+    round_no: int
+    payload: bytes
+    phase: object | None
+    error: Optional[DkgError]
+    drain_from: int
+    present: Optional[tuple[int, ...]]
+    quarantined_delta: int
+    timed_out: bool
+
+
+def encode_round_record(
+    group: HostGroup,
+    round_no: int,
+    payload: bytes,
+    phase=None,
+    *,
+    error: Optional[DkgError] = None,
+    drain_from: int = 0,
+    present: Optional[tuple[int, ...]] = None,
+    quarantined_delta: int = 0,
+    timed_out: bool = False,
+) -> bytes:
+    """Serialize one WAL round record (exactly one of phase/error set)."""
+    if (phase is None) == (error is None):
+        raise ValueError("round record needs exactly one of phase or error")
+    w = Writer(group)
+    w.raw(RECORD_MAGIC)
+    w.u8(VERSION)
+    w.u8(round_no)
+    w.lp(payload)
+    if error is None:
+        w.u8(_REC_STATE)
+        w.lp(checkpoint(group, phase))
+    else:
+        w.u8(_REC_TERMINAL)
+        w.u8(_ERR_CODES[error.kind])
+        w.u16(0 if error.index is None else error.index)
+        w.u8(1 if error.index is not None else 0)
+        w.lp(error.detail.encode())
+        w.u8(drain_from)
+    w.u8(1 if present is not None else 0)
+    if present is not None:
+        w.u16(len(present))
+        for j in present:
+            w.u16(j)
+    w.u32(quarantined_delta)
+    w.u8(1 if timed_out else 0)
+    return w.bytes()
+
+
+def decode_round_record(group: HostGroup, data: bytes) -> RoundRecord:
+    """Rebuild one WAL round record; raises ValueError on malformed
+    input (the replay loop in net.party treats that as a torn tail)."""
+    r = Reader(group, data)
+    if r.take(4) != RECORD_MAGIC:
+        raise ValueError("bad record magic")
+    if r.u8() != VERSION:
+        raise ValueError("unsupported record version")
+    round_no = r.u8()
+    payload = r.lp()
+    kind = r.u8()
+    phase = None
+    error = None
+    drain_from = 0
+    if kind == _REC_STATE:
+        phase = restore(group, r.lp())
+    elif kind == _REC_TERMINAL:
+        err_kind = _ERR_FROM.get(r.u8())
+        if err_kind is None:
+            raise ValueError("unknown error code in terminal record")
+        index = r.u16()
+        has_index = r.u8()
+        detail = r.lp().decode()
+        drain_from = r.u8()
+        error = DkgError(err_kind, index if has_index else None, detail)
+    else:
+        raise ValueError("unknown record kind")
+    present: Optional[tuple[int, ...]] = None
+    if r.u8():
+        present = tuple(r.u16() for _ in range(r.u16()))
+    quarantined_delta = r.u32()
+    timed_out = bool(r.u8())
+    r.done()
+    return RoundRecord(
+        round_no, payload, phase, error, drain_from,
+        present, quarantined_delta, timed_out,
+    )

@@ -12,10 +12,18 @@ Usage::
 
     python scripts/chaos_storm.py --ceremonies 8 --n 6 --t 2 --out CHAOS.json
     python scripts/chaos_storm.py --tcp          # exercise the TCP hub path
+    python scripts/chaos_storm.py --restarts 2   # crash-restart parties too
 
 Faulty parties are kept within the protocol's tolerance (at most t of
 the n members misbehave), so every run is *expected* to converge; a
 non-converging seed is a bug, not noise.
+
+With ``--restarts K``, up to K additional parties (outside the faulty
+set) are killed mid-round and re-spawned from their checkpoint WALs
+(net/checkpoint.py): restarted parties must ALSO finish ``ok`` with the
+agreed master key — a restart consumes zero fault budget, which is the
+whole point of durable checkpointing (docs/fault_model.md, "Crash
+recovery").
 """
 
 from __future__ import annotations
@@ -25,6 +33,7 @@ import json
 import os
 import random
 import sys
+import tempfile
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
@@ -46,8 +55,10 @@ G = gh.RISTRETTO255
 _BYTE_FAULTS = ("garbage", "truncate", "bitflip", "equivocate", "duplicate", "drop")
 
 
-def random_plan(seed: int, n: int, t: int, timeout: float) -> FaultPlan:
-    """Sample a fault schedule touching at most t of the n parties."""
+def random_plan(seed: int, n: int, t: int, timeout: float, restarts: int = 0) -> FaultPlan:
+    """Sample a fault schedule touching at most t of the n parties,
+    plus up to ``restarts`` mid-round crash-restarts on OTHER parties
+    (recoverable with checkpointing, so they sit outside the t budget)."""
     rng = random.Random(seed)
     plan = FaultPlan(seed)
     faulty = rng.sample(range(1, n + 1), rng.randint(1, t))
@@ -64,13 +75,20 @@ def random_plan(seed: int, n: int, t: int, timeout: float) -> FaultPlan:
             for _ in range(rng.randint(1, 2)):
                 kind = rng.choice(_BYTE_FAULTS)
                 getattr(plan, kind)(rng.randint(1, 5), sender)
+    if restarts:
+        candidates = [p for p in range(1, n + 1) if p not in faulty]
+        for sender in rng.sample(candidates, min(restarts, len(candidates))):
+            plan.restart(sender=sender, round_no=rng.randint(1, 5))
     return plan
 
 
-def run_one(seed: int, n: int, t: int, timeout: float, tcp: bool) -> dict:
+def run_one(
+    seed: int, n: int, t: int, timeout: float, tcp: bool, restarts: int = 0
+) -> dict:
     env, keys, pks = make_committee(G, n, t, seed)
-    plan = random_plan(seed, n, t, timeout)
+    plan = random_plan(seed, n, t, timeout, restarts=restarts)
     hub = None
+    ckpt = tempfile.TemporaryDirectory(prefix="dkg-wal-") if restarts else None
     try:
         if tcp:
             hub = TcpHub().start()
@@ -89,10 +107,19 @@ def run_one(seed: int, n: int, t: int, timeout: float, tcp: bool) -> dict:
             evidence_channel = chan
 
         t0 = time.monotonic()
-        results = run_with_faults(env, keys, pks, plan, factory, timeout=timeout, seed=seed)
+        results = run_with_faults(
+            env, keys, pks, plan, factory, timeout=timeout, seed=seed,
+            checkpoint_dir=ckpt.name if ckpt else None,
+        )
         wall = time.monotonic() - t0
         honest = honest_results(results, plan)
         masters = {G.encode(r.master.point).hex() for r in honest if r.ok}
+        restarted = [results[s - 1] for s in sorted(plan._restarts)]
+        restarted_masters = {
+            G.encode(r.master.point).hex()
+            for r in restarted
+            if isinstance(r, PartyResult) and r.ok
+        }
         return {
             "seed": seed,
             "plan": plan.as_dict(),
@@ -106,6 +133,7 @@ def run_one(seed: int, n: int, t: int, timeout: float, tcp: bool) -> dict:
                         "quarantined": r.quarantined,
                         "timeouts": r.timeouts,
                         "retries": r.retries,
+                        "resumes": r.resumes,
                     }
                     if isinstance(r, PartyResult)
                     else {"detail": str(r)}
@@ -115,6 +143,17 @@ def run_one(seed: int, n: int, t: int, timeout: float, tcp: bool) -> dict:
             "honest_parties": [r.index for r in honest],
             "honest_all_ok": bool(honest) and all(r.ok for r in honest),
             "honest_agreed": len(masters) == 1,
+            "restarted_parties": sorted(plan._restarts),
+            # the checkpointing contract: every restarted party recovers
+            # and lands on the same master key the honest set agreed on
+            "restarted_all_ok": (
+                all(isinstance(r, PartyResult) and r.ok for r in restarted)
+                if restarted
+                else None
+            ),
+            "restarted_agreed": (
+                restarted_masters <= masters if restarted else None
+            ),
             "equivocations": [
                 {"round": rn, "sender": s, "distinct_payloads": len(p)}
                 for (rn, s), p in sorted(evidence_channel.equivocation_evidence().items())
@@ -123,6 +162,8 @@ def run_one(seed: int, n: int, t: int, timeout: float, tcp: bool) -> dict:
     finally:
         if hub is not None:
             hub.stop()
+        if ckpt is not None:
+            ckpt.cleanup()
 
 
 def run_storm(
@@ -132,14 +173,27 @@ def run_storm(
     base_seed: int = 0xC7A05,
     timeout: float = 1.0,
     tcp: bool = False,
+    restarts: int = 0,
 ) -> dict:
-    runs = [run_one(base_seed + c, n, t, timeout, tcp) for c in range(ceremonies)]
-    survived = sum(r["honest_all_ok"] and r["honest_agreed"] for r in runs)
+    runs = [
+        run_one(base_seed + c, n, t, timeout, tcp, restarts=restarts)
+        for c in range(ceremonies)
+    ]
+    survived = sum(
+        r["honest_all_ok"]
+        and r["honest_agreed"]
+        and r["restarted_all_ok"] is not False
+        and r["restarted_agreed"] is not False
+        for r in runs
+    )
     fault_counts: dict[str, int] = {}
     for r in runs:
         for f in r["plan"]["faults"]:
             fault_counts[f["kind"]] = fault_counts.get(f["kind"], 0) + 1
         fault_counts["crash"] = fault_counts.get("crash", 0) + len(r["plan"]["crash_after"])
+        fault_counts["restart"] = fault_counts.get("restart", 0) + sum(
+            len(v) for v in r["plan"]["restarts"].values()
+        )
     return {
         "ceremonies": ceremonies,
         "n": n,
@@ -147,6 +201,7 @@ def run_storm(
         "base_seed": base_seed,
         "timeout_s": timeout,
         "transport": "tcp_hub" if tcp else "in_process",
+        "checkpointing": bool(restarts),
         "survived": survived,
         "survival_rate": survived / ceremonies if ceremonies else None,
         "faults_injected": dict(sorted(fault_counts.items())),
@@ -162,6 +217,11 @@ def main() -> int:
     ap.add_argument("--seed", type=lambda v: int(v, 0), default=0xC7A05)
     ap.add_argument("--timeout", type=float, default=1.0, help="per-round fetch timeout (s)")
     ap.add_argument("--tcp", action="store_true", help="run over a TcpHub instead of in-process")
+    ap.add_argument(
+        "--restarts", type=int, default=0,
+        help="also crash-restart up to K non-faulty parties per ceremony, "
+        "recovered from checkpoint WALs (0 = off)",
+    )
     ap.add_argument("--out", default="CHAOS.json")
     args = ap.parse_args()
 
@@ -172,6 +232,7 @@ def main() -> int:
         base_seed=args.seed,
         timeout=args.timeout,
         tcp=args.tcp,
+        restarts=args.restarts,
     )
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2, sort_keys=True)
@@ -179,7 +240,13 @@ def main() -> int:
         f"chaos storm: {report['survived']}/{report['ceremonies']} ceremonies survived "
         f"({report['transport']}); faults: {report['faults_injected']} -> {args.out}"
     )
-    bad = [r["seed"] for r in report["runs"] if not (r["honest_all_ok"] and r["honest_agreed"])]
+    bad = [
+        r["seed"]
+        for r in report["runs"]
+        if not (r["honest_all_ok"] and r["honest_agreed"])
+        or r["restarted_all_ok"] is False
+        or r["restarted_agreed"] is False
+    ]
     if bad:
         print(f"NON-CONVERGING SEEDS (reproduce via FaultPlan(seed)): {bad}", file=sys.stderr)
         return 1

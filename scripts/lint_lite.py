@@ -39,6 +39,12 @@ linter), so the committed baseline stays clean between CI runs:
         hash loop is the O(n) host pathology ``crypto.blake2.
         blake2b_batch`` exists to eliminate (host-oracle/audit legs:
         ``_dealer_row_digests`` only; docs/perf.md)
+* DKG005  (dkg_tpu/net/ only, net/checkpoint.py exempt) raw file write —
+        write-mode ``open()``, ``.write_bytes``/``.write_text``, or
+        fd-level ``os.open`` — outside the WAL: net-layer state carries
+        secret share material and must be persisted through
+        ``net.checkpoint.PartyWal`` only (0600, fsync'd, checksummed,
+        torn-tail tolerant; docs/fault_model.md "Crash recovery")
 
 Exit 0 = clean.  Run: ``python scripts/lint_lite.py`` (from repo root).
 Also executed by tests/test_import_hygiene.py so the default test tier
@@ -320,6 +326,40 @@ class _Checker(ast.NodeVisitor):
                     f"{name}() in dkg/ — use device_hash.row_digests/"
                     "tree_digest so the digest is jitted and "
                     "backend-dispatched (DKG_TPU_DIGEST)",
+                )
+        # DKG005: net-layer state (WAL records hold secret shares) is
+        # persisted ONLY through net.checkpoint.PartyWal — raw writes
+        # are not atomic, not fsync'd, not checksummed, and not 0600.
+        # checkpoint.py itself is the sanctioned fd-level writer.
+        if self._net_module and self.path.name != "checkpoint.py":
+            func = node.func
+            name = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else ""
+            )
+            raw_write = name in ("write_bytes", "write_text")
+            if not raw_write and name == "open":
+                if isinstance(func, ast.Attribute):
+                    recv = func.value
+                    # fd-level os.open: any use outside the WAL is a
+                    # hand-rolled persistence path
+                    raw_write = isinstance(recv, ast.Name) and recv.id == "os"
+                else:
+                    mode = node.args[1] if len(node.args) >= 2 else None
+                    for kw in node.keywords:
+                        if kw.arg == "mode":
+                            mode = kw.value
+                    raw_write = (
+                        isinstance(mode, ast.Constant)
+                        and isinstance(mode.value, str)
+                        and any(c in mode.value for c in "wax+")
+                    )
+            if raw_write:
+                self._add(
+                    node,
+                    "DKG005",
+                    f"raw file write ({name}) in dkg_tpu/net/ — persist "
+                    "through net.checkpoint.PartyWal (atomic, fsync'd, "
+                    "checksummed, 0600)",
                 )
         # DKG004b: a hashlib.blake2b call lexically inside a loop in a
         # batch hot module is a per-dealer host hash loop — use

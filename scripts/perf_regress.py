@@ -21,6 +21,10 @@ Deliberately forgiving about everything except a real regression:
 * different platforms (cpu vs tpu rounds) are incomparable -> exit 0
   with a note, since a tunnel dying mid-history says nothing about the
   code;
+* different ``config.checkpoint`` flags (one round measured with
+  durable WAL journaling armed, the other without) are likewise
+  incomparable -> exit 0 with a note: fsync'd checkpointing is a
+  deliberate durability cost, not a perf regression;
 * improvements and <=20% noise -> exit 0.
 
 Run: ``python scripts/perf_regress.py [--threshold 0.2] [dir]``.
@@ -87,6 +91,15 @@ def main(argv: list[str] | None = None) -> int:
         print(
             f"perf_regress: r{old_n} ({old_plat}) vs r{new_n} ({new_plat}) "
             "ran on different platforms — incomparable, skipping"
+        )
+        return 0
+    old_ckpt = bool((old.get("config") or {}).get("checkpoint"))
+    new_ckpt = bool((new.get("config") or {}).get("checkpoint"))
+    if old_ckpt != new_ckpt:
+        print(
+            f"perf_regress: r{old_n} (checkpoint={old_ckpt}) vs r{new_n} "
+            f"(checkpoint={new_ckpt}) measured different durability modes "
+            "— incomparable, skipping"
         )
         return 0
     # every gated metric goes through one loop with one forgiveness

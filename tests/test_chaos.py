@@ -26,6 +26,7 @@ from dkg_tpu.net.faults import (
     CrashFault,
     FaultPlan,
     FaultyChannel,
+    RestartFault,
     honest_results,
     make_committee,
     run_with_faults,
@@ -305,6 +306,105 @@ def test_counters_thread_into_ceremony_trace():
 
 
 # ---------------------------------------------------------------------------
+# durable checkpointing: restarted parties rejoin instead of being
+# reconstructed away (docs/fault_model.md, "Crash recovery")
+# ---------------------------------------------------------------------------
+
+
+def _restart_plan(seed):
+    return (
+        FaultPlan(seed)
+        .garbage(1, sender=2)  # Byzantine bytes in the dealing round
+        .equivocate(3, sender=5)  # two different round-3 messages
+        .restart(sender=4, round_no=2)  # dies mid-round 2 (rng-consuming round)
+        .restart(sender=6, round_no=4)  # dies mid-round 4
+    )
+
+
+def _restart_run(seed, checkpoint_dir):
+    plan = _restart_plan(seed)
+    env, keys, pks = make_committee(G, 8, 2, seed)
+    chan = InProcessChannel()
+    results = run_with_faults(
+        env, keys, pks, plan, lambda i: chan, timeout=1.8, seed=seed,
+        checkpoint_dir=checkpoint_dir,
+    )
+    return plan, results, chan
+
+
+def _disclosed_accused(chan, n):
+    """Accused indices whose shares anyone disclosed in round 5 — i.e.
+    the parties the ceremony actually reconstructed away."""
+    accused = set()
+    for payload in chan.fetch(5, n, timeout=0.1).values():
+        if not payload:
+            continue
+        try:
+            b5 = serde.decode_phase5(G, payload)
+        except ValueError:
+            continue
+        accused |= {d.accused_index for d in b5.disclosed_shares}
+    return accused
+
+
+def test_chaos_restarted_parties_rejoin_instead_of_reconstruction(tmp_path):
+    """The PR's acceptance scenario: n=8, t=2, two mid-round restarts on
+    top of garbage + equivocation.  With checkpointing, both restarted
+    parties resume from their WALs and finish ok with the byte-identical
+    master key — consuming ZERO fault budget."""
+    seed = 0xC7A06
+    plan, results, chan = _restart_run(seed, str(tmp_path / "a"))
+
+    # both restarted parties recovered: ok, resumed once, replayed
+    # exactly the rounds they had journaled before dying
+    for idx, died_in in ((4, 2), (6, 4)):
+        res = results[idx - 1]
+        assert isinstance(res, PartyResult) and res.ok, res
+        assert res.resumes == 1
+        assert res.replayed_rounds == died_in
+        assert res.wal_records == 5
+
+    # every untouched party AND both restarted parties agree byte-identically
+    honest = honest_results(results, plan)
+    assert len(honest) == 4 and all(r.ok for r in honest)
+    masters = _masters(honest) | _masters([results[3], results[5]])
+    assert len(masters) == 1
+
+    # zero restart-triggered reconstructions: nobody disclosed shares of
+    # the restarted parties, so the t budget still covers 2 real faults
+    assert not ({4, 6} & _disclosed_accused(chan, 8))
+    # resumed re-publishes were byte-identical: the only equivocation on
+    # the wire is the scheduled round-3 one
+    assert set(chan.equivocation_evidence()) == {(3, 5)}
+
+    # deterministic: the identical seed reproduces the identical outcome
+    plan2, results2, _ = _restart_run(seed, str(tmp_path / "b"))
+    assert plan2.as_dict() == plan.as_dict()
+    assert _masters(honest_results(results2, plan2)) == masters
+
+
+def test_chaos_same_restart_schedule_without_checkpointing_degrades():
+    """The exact schedule above minus checkpoint_dir: restarts become
+    terminal crashes and the ceremony survives the old way — dropout
+    plus reconstruction by the survivors."""
+    seed = 0xC7A06
+    plan = _restart_plan(seed)
+    env, keys, pks = make_committee(G, 8, 2, seed)
+    chan = InProcessChannel()
+    results = run_with_faults(
+        env, keys, pks, plan, lambda i: chan, timeout=1.8, seed=seed
+    )
+
+    assert isinstance(results[3], RestartFault)
+    assert isinstance(results[5], RestartFault)
+    honest = honest_results(results, plan)
+    assert len(honest) == 4 and all(r.ok for r in honest)
+    assert len(_masters(honest)) == 1
+    # here the round-2 casualty's secret WAS reconstructed away
+    assert 4 in _disclosed_accused(chan, 8)
+
+
+# ---------------------------------------------------------------------------
 # the storm: random schedules over many seeds (nightly tier)
 # ---------------------------------------------------------------------------
 
@@ -318,3 +418,14 @@ def test_chaos_storm_random_schedules():
     for entry in report["runs"]:
         assert entry["honest_all_ok"], entry
         assert entry["honest_agreed"], entry
+
+    # and with mid-round restarts recovered from checkpoint WALs on top
+    report = run_storm(
+        ceremonies=3, n=5, t=2, base_seed=0x57AC, timeout=0.8, restarts=2
+    )
+    assert report["checkpointing"]
+    for entry in report["runs"]:
+        assert entry["honest_all_ok"], entry
+        assert entry["honest_agreed"], entry
+        assert entry["restarted_all_ok"], entry
+        assert entry["restarted_agreed"], entry

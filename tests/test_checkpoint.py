@@ -1,0 +1,345 @@
+"""Durable party checkpointing: WAL mechanics + the crash-recovery
+contract (docs/fault_model.md, "Crash recovery").
+
+The load-bearing properties:
+
+* torn-tail tolerance — truncating or corrupting the log at ANY byte
+  offset of the final record replays to the intact prefix, and a party
+  resumed from that prefix still finishes with the byte-identical
+  master key (the write-ahead ordering makes the lost round safe to
+  redo);
+* clean degradation — a fully unusable WAL never raises: the party
+  reruns fresh and the ceremony falls back to today's
+  dropout/reconstruction semantics;
+* secrecy hygiene — WAL files carry share material and must be 0600.
+"""
+
+import os
+import random
+import threading
+
+import pytest
+
+from dkg_tpu.dkg.errors import DkgError, DkgErrorKind
+from dkg_tpu.groups import host as gh
+from dkg_tpu.net import InProcessChannel, PartyResult, PartyWal, run_party, wal_path
+from dkg_tpu.net.checkpoint import default_checkpoint_dir
+from dkg_tpu.net.faults import (
+    FaultPlan,
+    FaultyChannel,
+    RestartFault,
+    honest_results,
+    make_committee,
+    run_with_faults,
+)
+from dkg_tpu.utils import serde
+from dkg_tpu.utils.tracing import CeremonyTrace
+
+G = gh.RISTRETTO255
+
+
+# ---------------------------------------------------------------------------
+# WAL file mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_wal_append_replay_roundtrip_and_permissions(tmp_path):
+    wal = PartyWal(tmp_path / "p.wal")
+    bodies = [b"alpha", b"", os.urandom(300)]
+    for b in bodies:
+        wal.append(b)
+    assert wal.replay() == bodies
+    # the log holds secret share material: owner-only, always
+    assert (wal.path.stat().st_mode & 0o777) == 0o600
+
+
+def test_wal_unusable_logs_replay_to_nothing(tmp_path):
+    assert PartyWal(tmp_path / "missing.wal").replay() == []
+    garbage = tmp_path / "garbage.wal"
+    garbage.write_bytes(os.urandom(64))
+    assert PartyWal(garbage).replay() == []
+    empty = tmp_path / "empty.wal"
+    empty.write_bytes(b"")
+    assert PartyWal(empty).replay() == []
+
+
+def test_wal_reset_recreates_empty_0600(tmp_path):
+    wal = PartyWal(tmp_path / "p.wal")
+    wal.append(b"stale")
+    wal.reset()
+    assert wal.path.stat().st_size == 0
+    assert (wal.path.stat().st_mode & 0o777) == 0o600
+    wal.append(b"fresh")
+    assert wal.replay() == [b"fresh"]
+
+
+def test_wal_rewrite_is_equivalent_to_appends(tmp_path):
+    a, b = PartyWal(tmp_path / "a.wal"), PartyWal(tmp_path / "b.wal")
+    bodies = [b"one", b"two", b"three"]
+    for body in bodies:
+        a.append(body)
+    b.rewrite(bodies)
+    assert a.path.read_bytes() == b.path.read_bytes()
+    assert (b.path.stat().st_mode & 0o777) == 0o600
+
+
+def test_wal_torn_tail_at_every_byte_offset_keeps_prefix(tmp_path):
+    """Satellite property test: cut (or corrupt) the log at EVERY byte
+    offset of the final record — replay must return exactly the intact
+    prefix, so resume falls back to the previous round."""
+    wal = PartyWal(tmp_path / "p.wal")
+    bodies = [b"round-1 record", b"round-2 record", b"round-3 record"]
+    wal.append(bodies[0])
+    wal.append(bodies[1])
+    prefix_len = wal.path.stat().st_size
+    wal.append(bodies[2])
+    full = wal.path.read_bytes()
+
+    torn = PartyWal(tmp_path / "torn.wal")
+    for cut in range(prefix_len, len(full)):
+        torn.path.write_bytes(full[:cut])
+        assert torn.replay() == bodies[:2], f"truncation at offset {cut}"
+    for pos in range(prefix_len, len(full)):
+        blob = bytearray(full)
+        blob[pos] ^= 0x5A
+        torn.path.write_bytes(bytes(blob))
+        assert torn.replay() == bodies[:2], f"corruption at offset {pos}"
+
+
+# ---------------------------------------------------------------------------
+# round-record codec
+# ---------------------------------------------------------------------------
+
+
+def test_round_record_codec_roundtrips_state_and_terminal():
+    from dkg_tpu.dkg.committee import DistributedKeyGeneration
+
+    env, keys, pks = make_committee(G, 3, 1, seed=5)
+    phase1, _ = DistributedKeyGeneration.init(env, random.Random(1), keys[0], pks, 1)
+
+    body = serde.encode_round_record(
+        G, 1, b"\x01\x02", phase1, present=None, quarantined_delta=0
+    )
+    rec = serde.decode_round_record(G, body)
+    assert (rec.round_no, rec.payload, rec.error) == (1, b"\x01\x02", None)
+    # the restored phase is the same snapshot, byte for byte
+    assert serde.checkpoint(G, rec.phase) == serde.checkpoint(G, phase1)
+
+    err = DkgError(DkgErrorKind.NOT_ENOUGH_MEMBERS, index=7, detail="boom")
+    body = serde.encode_round_record(
+        G, 2, b"evidence", error=err, drain_from=3,
+        present=(1, 3), quarantined_delta=2, timed_out=True,
+    )
+    rec = serde.decode_round_record(G, body)
+    assert rec.error == err and rec.drain_from == 3 and rec.phase is None
+    assert rec.present == (1, 3)
+    assert rec.quarantined_delta == 2 and rec.timed_out
+
+    with pytest.raises(ValueError):
+        serde.encode_round_record(G, 1, b"", None)  # neither phase nor error
+    with pytest.raises(ValueError):
+        serde.decode_round_record(G, b"not a record")
+    with pytest.raises(ValueError):
+        serde.decode_round_record(G, body[:-3])
+
+
+def test_checkpoint_dir_knob(monkeypatch):
+    monkeypatch.delenv("DKG_TPU_CHECKPOINT_DIR", raising=False)
+    assert default_checkpoint_dir() is None
+    monkeypatch.setenv("DKG_TPU_CHECKPOINT_DIR", "")
+    assert default_checkpoint_dir() is None  # empty = unset, like every knob
+    monkeypatch.setenv("DKG_TPU_CHECKPOINT_DIR", "/tmp/ckpt")
+    assert default_checkpoint_dir() == "/tmp/ckpt"
+
+
+# ---------------------------------------------------------------------------
+# restart fault mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_restart_fault_fires_once_per_scheduled_round():
+    plan = FaultPlan(0).restart(sender=2, round_no=3)
+    chan = FaultyChannel(InProcessChannel(), plan, party=2)
+    chan.publish(3, 2, b"published before dying")
+    with pytest.raises(RestartFault):
+        chan.fetch(3, 1, timeout=0.1)
+    # the respawned incarnation passes straight through
+    assert chan.fetch(3, 1, timeout=0.1) == {2: b"published before dying"}
+    plan.reset_runtime()
+    with pytest.raises(RestartFault):
+        chan.fetch(3, 1, timeout=0.1)
+
+
+def test_restart_in_plan_dict_and_honest_set():
+    import json
+
+    plan = FaultPlan(1).restart(sender=4, round_no=2).restart(sender=4, round_no=5)
+    d = plan.as_dict()
+    assert json.loads(json.dumps(d)) == d
+    assert d["restarts"] == {"4": [2, 5]}
+    # restarted parties are plan-touched: excluded from honest_results
+    results = [PartyResult(i) for i in range(1, 6)]
+    assert [r.index for r in honest_results(results, plan)] == [1, 2, 3, 5]
+
+
+# ---------------------------------------------------------------------------
+# ceremony-level recovery
+# ---------------------------------------------------------------------------
+
+
+def _checkpointed_ceremony(tmp_path, n=3, t=1, seed=21, timeout=1.0):
+    """Fault-free ceremony where every party journals to tmp_path."""
+    env, keys, pks = make_committee(G, n, t, seed)
+    chan = InProcessChannel()
+    results = run_with_faults(
+        env, keys, pks, FaultPlan(seed), lambda i: chan,
+        timeout=timeout, seed=seed, checkpoint_dir=str(tmp_path),
+    )
+    assert all(isinstance(r, PartyResult) and r.ok for r in results)
+    masters = {G.encode(r.master.point) for r in results}
+    assert len(masters) == 1
+    return env, keys, pks, chan, results, masters.pop()
+
+
+def test_resume_from_torn_final_record_reaches_identical_master(tmp_path):
+    """Ceremony-level satellite check: truncate the finished WAL inside
+    its final record at several offsets; a fresh incarnation (new rng!)
+    must resume from the prior round, re-finish ok with the
+    byte-identical master key, and never equivocate."""
+    env, keys, pks, chan, _, master = _checkpointed_ceremony(tmp_path)
+    wal = PartyWal(wal_path(tmp_path, 1))
+    bodies = wal.replay()
+    assert len(bodies) == 5  # one record per round
+    full = wal.path.read_bytes()
+    final_frame = 4 + len(bodies[4]) + 16
+    prefix_len = len(full) - final_frame
+
+    for cut in (prefix_len, prefix_len + 1, prefix_len + final_frame // 2,
+                len(full) - 17, len(full) - 1):
+        wal.path.write_bytes(full[:cut])
+        trace = CeremonyTrace()
+        res = run_party(
+            chan, env, keys[0], pks, 1, random.Random(0xFE5C + cut),
+            timeout=1.0, trace=trace, checkpoint=wal.path,
+        )
+        assert res.ok and G.encode(res.master.point) == master
+        assert res.resumes == 1 and res.replayed_rounds == 4
+        assert res.wal_records == 5  # the redone round was re-journaled
+        assert trace.counters["net.resumes"] == 1
+        assert trace.counters["wal.replayed_rounds"] == 4
+        assert trace.counters["wal.records"] == 5
+        assert "net_resume" in trace.timings_s
+    # re-publishes were byte-identical: first-publish-wins saw no conflict
+    assert chan.equivocation_evidence() == {}
+    # the resume compacted the torn tail: the log replays clean again
+    assert [len(b) for b in PartyWal(wal.path).replay()] == [len(b) for b in bodies]
+
+
+def test_resume_survives_double_crash(tmp_path):
+    """Crash, resume, crash again: the first resume must compact the
+    torn tail so the second resume sees the re-journaled round."""
+    env, keys, pks, chan, _, master = _checkpointed_ceremony(
+        tmp_path, seed=22
+    )
+    wal = PartyWal(wal_path(tmp_path, 1))
+    full = wal.path.read_bytes()
+    wal.path.write_bytes(full[:-5])  # torn tail in record 5
+    res = run_party(chan, env, keys[0], pks, 1, random.Random(1), timeout=1.0,
+                    checkpoint=wal.path)
+    assert res.ok and res.replayed_rounds == 4
+    wal.path.write_bytes(wal.path.read_bytes()[:-5])  # tear it again
+    res = run_party(chan, env, keys[0], pks, 1, random.Random(2), timeout=1.0,
+                    checkpoint=wal.path)
+    assert res.ok and res.replayed_rounds == 4
+    assert G.encode(res.master.point) == master
+
+
+def test_fully_corrupt_wal_degrades_to_dropout_semantics(tmp_path):
+    """A party whose WAL is destroyed between crash and restart reruns
+    fresh: never an exception, and the ceremony treats it exactly like a
+    dropout — survivors reconstruct and agree."""
+    seed = 23
+    env, keys, pks = make_committee(G, 4, 1, seed)
+    chan = InProcessChannel()
+    plan = FaultPlan(seed).restart(sender=1, round_no=2)
+    survivors: list = [None] * 3
+
+    def worker(i):  # parties 2..4, honest, no checkpoint needed
+        survivors[i - 1] = run_party(
+            chan, env, keys[i], pks, i + 1, random.Random(seed + i), timeout=1.5
+        )
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in (1, 2, 3)]
+    for th in threads:
+        th.start()
+
+    wal = wal_path(tmp_path, 1)
+    faulty = FaultyChannel(chan, plan, party=1)
+    with pytest.raises(RestartFault):
+        run_party(faulty, env, keys[0], pks, 1, random.Random(seed),
+                  timeout=1.5, checkpoint=wal)
+    # the crash left a journal; destroy it completely
+    wal.write_bytes(os.urandom(200))
+    res = run_party(faulty, env, keys[0], pks, 1, random.Random(seed + 99),
+                    timeout=1.5, checkpoint=wal)
+    assert isinstance(res, PartyResult)  # degraded, never raised
+
+    for th in threads:
+        th.join(timeout=120)
+    assert all(r is not None and r.ok for r in survivors), survivors
+    masters = {G.encode(r.master.point) for r in survivors}
+    assert len(masters) == 1
+
+
+def test_perf_regress_skips_on_checkpoint_mode_mismatch(tmp_path):
+    """Rounds benched with and without durable WAL journaling armed are
+    incomparable: the gate must skip, not flag the fsync cost as a
+    regression — and still trip on a real drop within one mode."""
+    import json
+    import pathlib
+    import sys
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "scripts"))
+    try:
+        import perf_regress
+    finally:
+        sys.path.pop(0)
+
+    def bench_round(rnd, ckpt, value):
+        (tmp_path / f"BENCH_r{rnd:02d}.json").write_text(
+            json.dumps(
+                {
+                    "parsed": {
+                        "value": value,
+                        "unit": "pair-verifications/s",
+                        "config": {"platform": "cpu", "checkpoint": ckpt},
+                    }
+                }
+            )
+        )
+
+    bench_round(1, False, 1000.0)
+    bench_round(2, True, 10.0)  # 99% drop, but a different durability mode
+    assert perf_regress.main([str(tmp_path)]) == 0
+    bench_round(2, False, 10.0)  # same mode: the drop must trip the gate
+    assert perf_regress.main([str(tmp_path)]) == 1
+
+
+def test_run_party_without_checkpoint_reports_zero_wal_counters():
+    env, keys, pks = make_committee(G, 3, 1, seed=31)
+    chan = InProcessChannel()
+    results: list = [None] * 3
+
+    def worker(i):
+        results[i] = run_party(
+            chan, env, keys[i], pks, i + 1, random.Random(i), timeout=1.0
+        )
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(3)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=120)
+    for r in results:
+        assert r.ok
+        assert (r.resumes, r.wal_records, r.replayed_rounds) == (0, 0, 0)

@@ -47,6 +47,56 @@ def test_lint_lite_clean():
     assert lint_lite.run() == 0, "lint_lite found problems (see stdout)"
 
 
+def test_lint_dkg005_bans_raw_writes_in_net():
+    """DKG005: net-layer code persists state only through the WAL —
+    write-mode open(), Path.write_bytes/.write_text, and fd-level
+    os.open are flagged everywhere in dkg_tpu/net/ except the WAL
+    implementation itself."""
+    import ast
+    import pathlib
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "scripts"))
+    try:
+        import lint_lite
+    finally:
+        sys.path.pop(0)
+
+    src = (
+        "import os\n"
+        "def f(p):\n"
+        "    open(p, 'wb').write(b'x')\n"
+        "    open(p, mode='a').write('x')\n"
+        "    p.write_bytes(b'x')\n"
+        "    p.write_text('x')\n"
+        "    os.open(p, os.O_WRONLY)\n"
+        "    open(p).read()\n"  # read-mode: fine
+    )
+    tree = ast.parse(src)
+    codes = [
+        c
+        for _, c, _ in lint_lite._Checker(
+            pathlib.Path("dkg_tpu/net/evil.py"), tree, src
+        ).finish()
+    ]
+    assert codes.count("DKG005") == 5, codes
+    # the WAL implementation is the sanctioned fd-level writer
+    codes = [
+        c
+        for _, c, _ in lint_lite._Checker(
+            pathlib.Path("dkg_tpu/net/checkpoint.py"), tree, src
+        ).finish()
+    ]
+    assert "DKG005" not in codes, codes
+    # and the rule is net-scoped: the same source elsewhere is clean
+    codes = [
+        c
+        for _, c, _ in lint_lite._Checker(
+            pathlib.Path("dkg_tpu/dkg/elsewhere.py"), tree, src
+        ).finish()
+    ]
+    assert "DKG005" not in codes, codes
+
+
 def test_hostmesh_import_is_lightweight():
     # The driver image's sitecustomize preloads jax itself, so "jax not
     # in sys.modules" is unattainable; assert the real invariants: no

@@ -10,6 +10,7 @@ in tests/test_chaos.py.
 import io
 import random
 import socket
+import struct
 import threading
 import time
 
@@ -27,7 +28,7 @@ from dkg_tpu.net import (
     TruncatedStream,
     run_party,
 )
-from dkg_tpu.net.channel import _read_exact
+from dkg_tpu.net.channel import _read_ack, _read_exact
 from dkg_tpu.poly.host import lagrange_interpolation
 
 RNG = random.Random(0x4E7)
@@ -303,3 +304,71 @@ def test_net_knobs_validated(monkeypatch):
     chan = TcpHubChannel("127.0.0.1", 1)
     assert chan._backoff_s == 0.0
     assert chan._budget_s == 90.0
+
+
+def test_tcp_channel_budget_clamps_publish_and_evidence():
+    """Regression: DKG_TPU_NET_BUDGET_S used to clamp only ``fetch`` —
+    a hub that accepted connections but never replied could stall every
+    ``publish`` (and ``equivocation_counts``) for the full io timeout
+    per attempt.  Now every RPC's socket deadline is clamped to the
+    remaining budget (with a small floor so last publishes still land),
+    and no retry starts past the deadline."""
+    srv = socket.socket()  # accepts connections, never replies
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    host, port = srv.getsockname()
+    try:
+        chan = TcpHubChannel(
+            host, port, attempts=3, backoff_ms=1, io_timeout_s=30.0,
+            budget_s=0.5, rng=random.Random(3),
+        )
+        t0 = time.monotonic()
+        with pytest.raises(RetryBudgetExceeded):
+            chan.publish(1, 1, b"x")
+        with pytest.raises(RetryBudgetExceeded):
+            chan.equivocation_counts()
+        elapsed = time.monotonic() - t0
+        # each RPC: one floor-clamped attempt (~1 s), then the retry is
+        # refused — nowhere near attempts x io_timeout_s
+        assert elapsed < 10.0, elapsed
+        assert chan.stats["budget_clamps"] >= 2
+        assert chan.stats["retries"] == 0  # refused, not burned
+    finally:
+        srv.close()
+
+
+def test_tcp_hub_replies_error_byte_to_junk_frames():
+    """Regression: the hub used to swallow unknown opcodes without a
+    reply and let struct/short-read errors kill the handler silently —
+    either way the client hung until its socket timeout.  Now every
+    malformed frame gets an explicit error ack, promptly."""
+    hub = TcpHub(frame_timeout_s=1.0).start()
+    try:
+        host, port = hub.address
+        t0 = time.monotonic()
+        # unknown opcode
+        with socket.create_connection((host, port), timeout=5.0) as s:
+            s.sendall(bytes([0xFF]) + b"junk")
+            assert s.recv(1) == b"\x00"
+        # short frame: the header promises 100 payload bytes that never
+        # arrive; the frame timeout bounds the read, then the error byte
+        with socket.create_connection((host, port), timeout=5.0) as s:
+            s.sendall(bytes([1]) + struct.pack("<III", 1, 1, 100) + b"short")
+            assert s.recv(1) == b"\x00"
+        # truncated header (connection half closed mid-frame)
+        with socket.create_connection((host, port), timeout=5.0) as s:
+            s.sendall(bytes([1]) + b"\x01\x00")
+            s.shutdown(socket.SHUT_WR)
+            assert s.recv(1) == b"\x00"
+        assert time.monotonic() - t0 < 4.0
+        # the client treats the error ack as a typed, retryable failure
+        chan = TcpHubChannel(
+            host, port, attempts=2, backoff_ms=1, rng=random.Random(4)
+        )
+        with pytest.raises(RetryBudgetExceeded, match="error ack"):
+            chan._rpc(bytes([0xFE]), _read_ack, 5.0)
+        # and the hub still serves well-formed clients afterwards
+        chan.publish(1, 7, b"still alive")
+        assert chan.fetch(1, 1, timeout=1.0) == {7: b"still alive"}
+    finally:
+        hub.stop()
