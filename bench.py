@@ -710,6 +710,7 @@ def main():
         # JSON speaks the same dialect as CeremonyTrace consumers; the
         # one-off table acquisition gets its own key ("tables") instead
         # of polluting the steady-state phases.
+        from dkg_tpu.utils import metrics
         from dkg_tpu.utils.tracing import CeremonyTrace
 
         phase_trace = CeremonyTrace(
@@ -719,9 +720,17 @@ def main():
                 "fiat_shamir": res["fiat_shamir_s"],
                 "seal": res.get("seal_s") or 0.0,
                 "tables": res.get("table_s") or 0.0,
-            }
+            },
+            meta={"units": pairs},
         )
-        rates = {k: round(v, 1) for k, v in phase_trace.rates(pairs).items()}
+        # the units hint makes as_dict() carry rates_per_s itself — one
+        # derivation shared with every other CeremonyTrace consumer
+        rates = {
+            k: round(v, 1) for k, v in phase_trace.as_dict()["rates_per_s"].items()
+        }
+        # this trace was assembled from child timings, not phase_span, so
+        # feeding it here is the histogram's only observation of it
+        metrics.observe_trace(phase_trace)
         # the dealing metric: n*n sealed pairs (every dealer seals to
         # every recipient, self included) over the vectorized pipeline —
         # its exact count, not the n*(n-1) verify-pair count rates()
@@ -803,6 +812,11 @@ def main():
                         "north_star": north_star,
                         "kem": kem,
                     },
+                    # process-wide registry snapshot (utils.metrics):
+                    # phase histograms observed above plus anything the
+                    # in-process warmup touched — perf_regress.py passes
+                    # this block through untouched
+                    "metrics": metrics.REGISTRY.snapshot(),
                 }
             )
         )

@@ -47,11 +47,13 @@ import threading
 import time
 from typing import Optional, Protocol
 
-from ..utils import envknobs
+from ..utils import envknobs, obslog
+from ..utils.metrics import REGISTRY
 
 _OP_PUB = 1
 _OP_FETCH = 2
 _OP_EVID = 3
+_OP_NAMES = {_OP_PUB: "publish", _OP_FETCH: "fetch", _OP_EVID: "evidence"}
 
 # How many distinct payloads (the original + alternates) to retain per
 # equivocating (round, sender) as evidence before only counting.
@@ -155,6 +157,8 @@ class InProcessChannel:
 class _HubHandler(socketserver.StreamRequestHandler):
     def handle(self) -> None:  # one request per connection
         hub: "TcpHub" = self.server.hub  # type: ignore[attr-defined]
+        t0 = time.perf_counter()
+        op = None
         try:
             # a sender that opens a connection but never completes its
             # frame must not pin a handler thread forever
@@ -165,6 +169,7 @@ class _HubHandler(socketserver.StreamRequestHandler):
                 payload = _read_exact(self.rfile, ln)
                 hub.channel.publish(round_no, sender, payload)
                 self.wfile.write(_ACK_OK)
+                hub._observe_rpc("publish", time.perf_counter() - t0, 13 + ln, 1)
             elif op == _OP_FETCH:
                 round_no, expected, timeout_ms = struct.unpack(
                     "<III", _read_exact(self.rfile, 12)
@@ -174,20 +179,26 @@ class _HubHandler(socketserver.StreamRequestHandler):
                 for sender, payload in sorted(got.items()):
                     out.append(struct.pack("<II", sender, len(payload)))
                     out.append(payload)
-                self.wfile.write(b"".join(out))
+                reply = b"".join(out)
+                self.wfile.write(reply)
+                hub._observe_rpc("fetch", time.perf_counter() - t0, 13, len(reply))
             elif op == _OP_EVID:
                 ev = hub.channel.equivocation_evidence()
                 out = [struct.pack("<I", len(ev))]
                 for (round_no, sender), payloads in sorted(ev.items()):
                     out.append(struct.pack("<III", round_no, sender, len(payloads)))
-                self.wfile.write(b"".join(out))
+                reply = b"".join(out)
+                self.wfile.write(reply)
+                hub._observe_rpc("evidence", time.perf_counter() - t0, 1, len(reply))
             else:
                 # unknown opcode: reply with an explicit error byte so
                 # the client fails NOW, not at its socket timeout
                 self.wfile.write(_ACK_ERR)
+                hub._observe_junk("unknown_opcode")
         except (ConnectionError, TransportError, struct.error, OSError):
             # malformed/short/stalled frame: best-effort error byte, then
             # the connection closes — never a silent hang for the client
+            hub._observe_junk("malformed_frame", op=op)
             self._best_effort_error()
 
     def _best_effort_error(self) -> None:
@@ -242,6 +253,10 @@ class TcpHub:
         self._server.hub = self  # type: ignore[attr-defined]
         self.address = self._server.server_address
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        # hub-side flight recorder (file sink only when DKG_TPU_OBSLOG
+        # is set); handler threads have no ambient party recorder, so
+        # the hub owns its own log
+        self.obs = obslog.from_env(party="hub")
 
     def start(self) -> "TcpHub":
         self._thread.start()
@@ -250,6 +265,23 @@ class TcpHub:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        if self.obs is not None:
+            self.obs.close()
+
+    # -- hub-side observability (called from handler threads) ---------------
+
+    def _observe_rpc(self, op: str, dt: float, n_in: int, n_out: int) -> None:
+        REGISTRY.inc("dkg_hub_rpcs_total", op=op)
+        REGISTRY.observe("dkg_hub_rpc_seconds", dt, op=op)
+        REGISTRY.inc("dkg_hub_bytes_total", n_in, direction="in")
+        REGISTRY.inc("dkg_hub_bytes_total", n_out, direction="out")
+        if self.obs is not None:
+            self.obs.emit("hub_rpc", op=op, dur_s=dt, bytes_in=n_in, bytes_out=n_out)
+
+    def _observe_junk(self, reason: str, op: int | None = None) -> None:
+        REGISTRY.inc("dkg_hub_junk_frames_total", reason=reason)
+        if self.obs is not None:
+            self.obs.emit("hub_junk_frame", reason=reason, op=op)
 
 
 class TcpHubChannel:
@@ -342,6 +374,7 @@ class TcpHubChannel:
         the deadline (the first attempt always runs: peers' drains
         depend on publishes landing even at the buzzer)."""
         self.stats["rpcs"] += 1
+        REGISTRY.inc("dkg_client_rpcs_total")
         last: Optional[Exception] = None
         for attempt in range(self._attempts):
             remaining = self._budget_remaining()
@@ -352,6 +385,8 @@ class TcpHubChannel:
                         f"to {self._addr}: {last!r}"
                     )
                 self.stats["retries"] += 1
+                REGISTRY.inc("dkg_client_rpc_retries_total")
+                obslog.emit_current("rpc_retry", attempt=attempt, error=repr(last))
                 step = min(_BACKOFF_CAP_S, self._backoff_s * (2 ** (attempt - 1)))
                 time.sleep(step * (0.5 + self._rng.random()))
             timeout = io_timeout
@@ -359,6 +394,8 @@ class TcpHubChannel:
                 clamped = min(io_timeout, max(remaining, _POST_BUDGET_IO_FLOOR_S))
                 if clamped < timeout:
                     self.stats["budget_clamps"] += 1
+                    REGISTRY.inc("dkg_client_budget_clamps_total")
+                    obslog.emit_current("budget_clamp", where="rpc", timeout_s=clamped)
                     timeout = clamped
             try:
                 with socket.create_connection(self._addr, timeout=timeout) as s:
@@ -379,6 +416,10 @@ class TcpHubChannel:
         remaining = self._budget_remaining()
         if remaining is not None and remaining < timeout:
             self.stats["budget_clamps"] += 1
+            REGISTRY.inc("dkg_client_budget_clamps_total")
+            obslog.emit_current(
+                "budget_clamp", where="fetch", round=round_no, timeout_s=remaining
+            )
             timeout = remaining
         timeout_ms = min(int(timeout * 1000), 0xFFFFFFFF)
         msg = bytes([_OP_FETCH]) + struct.pack("<III", round_no, expected, timeout_ms)

@@ -49,6 +49,8 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..utils import obslog
+from ..utils.metrics import REGISTRY
 from .channel import BroadcastChannel
 from .checkpoint import wal_path
 from .party import PartyResult, run_party
@@ -63,6 +65,14 @@ _KIND_CODES = {
     "duplicate": 7,
     "equivocate": 8,
 }
+
+
+def _note_fault(kind: str, round_no: int, sender: int) -> None:
+    """Every injected fault is observable: a per-kind counter plus a
+    flight-recorder event in the victim party's log, so a chaos failure
+    can be replayed from its logs alone (module docstring)."""
+    REGISTRY.inc("dkg_faults_injected_total", kind=kind)
+    obslog.emit_current("fault_injected", round=round_no, fault=kind, sender=sender)
 
 
 class CrashFault(RuntimeError):
@@ -243,6 +253,7 @@ class FaultyChannel:
 
     def _check_crash(self, round_no: int) -> None:
         if self._plan.crashes_at(self._party, round_no):
+            _note_fault("crash", round_no, self._party)
             raise CrashFault(f"party {self._party} crashed before round {round_no}")
 
     def publish(self, round_no: int, sender: int, payload: bytes) -> None:
@@ -250,6 +261,7 @@ class FaultyChannel:
         plan = self._plan
         publishes = [payload]
         for kind, arg in plan.faults_for(round_no, sender):
+            _note_fault(kind, round_no, sender)
             if kind == "drop":
                 return
             elif kind == "delay":
@@ -277,7 +289,11 @@ class FaultyChannel:
         # a restart strikes mid-round: the publish already landed (and,
         # with checkpointing, its WAL record is durable), the fetch never
         # completes — the classic crash window recovery must cover
-        self._plan.check_restart(self._party, round_no)
+        try:
+            self._plan.check_restart(self._party, round_no)
+        except RestartFault:
+            _note_fault("restart", round_no, self._party)
+            raise
         return self._inner.fetch(round_no, expected, timeout)
 
     def __getattr__(self, name: str):

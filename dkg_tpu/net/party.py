@@ -59,7 +59,7 @@ from ..dkg.procedure_keys import (
     MemberCommunicationPublicKey,
     MemberSecretShare,
 )
-from ..utils import serde
+from ..utils import metrics, obslog, serde
 from ..utils.tracing import CeremonyTrace, phase_span
 from .channel import BroadcastChannel
 from .checkpoint import PartyWal
@@ -156,6 +156,9 @@ class _FetchOutcome:
 
 
 def _publish(channel, round_no: int, my: int, payload: Optional[bytes]) -> None:
+    # flight-recorder events carry LENGTHS only, never payload bytes —
+    # round 1/5 payloads hold encrypted shares and disclosures
+    obslog.emit_current("publish", round=round_no, bytes=len(payload or b""))
     channel.publish(round_no, my, payload or b"")
 
 
@@ -204,6 +207,7 @@ class _PartyRun:
                     b = None
                 if b is None and counting:
                     self.result.quarantined += 1
+                    obslog.emit_current("quarantine", round=round_no, peer=j)
             out.append(wrap(self.env, j, b))
         return out
 
@@ -218,6 +222,13 @@ class _PartyRun:
         lst = self._decode_list(round_no, got, counting=True)
         self.last_outcome = _FetchOutcome(
             tuple(sorted(got)), self.result.quarantined - q0, timed_out
+        )
+        obslog.emit_current(
+            "round_tail",
+            round=round_no,
+            present=len(got),
+            quarantined_delta=self.result.quarantined - q0,
+            timed_out=timed_out,
         )
         if round_no == 1:
             self.fetched1 = lst
@@ -240,8 +251,13 @@ class _PartyRun:
         )
         self.wal.append(body)
         self.result.wal_records += 1
+        obslog.emit_current(
+            "wal_record", round=round_no, bytes=len(body), terminal=error is not None
+        )
 
     def _abort(self, err: DkgError, drain_from: int) -> None:
+        # error KIND only — DkgError bodies can reference protocol state
+        obslog.emit_current("abort", error=err.kind.name, drain_from=drain_from)
         self.result.error = err
         _drain(self.channel, self.my, drain_from, self.result)
         self.finished = True
@@ -259,6 +275,17 @@ class _PartyRun:
             self.trace.bump("wal.records", res.wal_records)
             self.trace.bump("wal.replayed_rounds", res.replayed_rounds)
             self.trace.meta.setdefault("party_index", self.my)
+        obslog.emit_current(
+            "party_done",
+            ok=res.ok,
+            quarantined=res.quarantined,
+            timeouts=res.timeouts,
+            retries=res.retries,
+            resumes=res.resumes,
+            wal_records=res.wal_records,
+            replayed_rounds=res.replayed_rounds,
+        )
+        metrics.observe_party_result(res)
         return res
 
     # -- per-round heads (transition, record, publish) ----------------------
@@ -379,6 +406,7 @@ class _PartyRun:
         # on the next replay (the double-crash case)
         self.wal.rewrite(bodies)
         with phase_span(self.trace, "net_resume", annotate_device=False):
+            obslog.emit_current("wal_resume", replayed_rounds=len(records))
             res = self.result
             res.resumes = 1
             res.replayed_rounds = len(records)
@@ -414,6 +442,7 @@ class _PartyRun:
         for r in range(max(1, resume_round), 6):
             with phase_span(self.trace, f"net_round{r}", annotate_device=False):
                 if r != resume_round:
+                    obslog.emit_current("round_head", round=r)
                     self._HEADS[r](self)
                     if self.finished:
                         return self._finish()
@@ -433,6 +462,7 @@ def run_party(
     timeout: float = 30.0,
     trace: Optional[CeremonyTrace] = None,
     checkpoint: Optional[object] = None,
+    obs: Optional[obslog.ObsLog] = None,
 ) -> PartyResult:
     """Execute one party's side of the ceremony over ``channel``.
 
@@ -447,10 +477,26 @@ def run_party(
     every publish, and a restarted process pointed at the same WAL
     resumes from the first unfinished round with the byte-identical
     outcome (module docstring; docs/fault_model.md, "Crash recovery").
+
+    ``obs`` is this party's flight recorder; when None and the
+    ``DKG_TPU_OBSLOG`` env knob names a directory, one is created with a
+    JSONL sink there (``{ceremony_id}-p{my:03d}.jsonl``).  The recorder
+    is bound as the thread's ambient log for the run, so channel retries
+    and injected faults land in the same event stream.
     """
     wal = None
     if checkpoint is not None:
         wal = checkpoint if isinstance(checkpoint, PartyWal) else PartyWal(checkpoint)
-    return _PartyRun(
-        channel, env, comm_key, committee_pks, my, rng, timeout, trace, wal
-    ).execute()
+    owned = None
+    if obs is None:
+        obs = owned = obslog.from_env(
+            ceremony_id=obslog.ceremony_id_for(env), party=my
+        )
+    try:
+        with obslog.use(obs):
+            return _PartyRun(
+                channel, env, comm_key, committee_pks, my, rng, timeout, trace, wal
+            ).execute()
+    finally:
+        if owned is not None:
+            owned.close()

@@ -1,3 +1,4 @@
-"""Cross-cutting utilities: serialization/checkpointing, tracing."""
+"""Cross-cutting utilities: serialization/checkpointing, tracing,
+metrics, and the flight-recorder event log."""
 
-from . import serde, tracing  # noqa: F401
+from . import metrics, obslog, serde, tracing  # noqa: F401

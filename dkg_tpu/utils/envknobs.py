@@ -12,7 +12,8 @@ DKG_TPU_PALLAS / DKG_TPU_ASSUME_BACKEND via fields.device,
 DKG_TPU_MXU via fields.matmul, DKG_TPU_TABLE_CACHE via
 groups.precompute, DKG_TPU_NET_* transport knobs via net.channel,
 DKG_TPU_CHECKPOINT_DIR via net.checkpoint,
-DKG_TPU_DIGEST via crypto.device_hash.digest_dispatch).
+DKG_TPU_DIGEST via crypto.device_hash.digest_dispatch,
+DKG_TPU_OBSLOG flight-recorder log directory via utils.obslog).
 
 An EMPTY value is everywhere treated as unset: ``DKG_TPU_X= cmd`` is
 the shell idiom for clearing a knob on one invocation, and must select

@@ -1,0 +1,332 @@
+"""Ceremony flight recorder: structured JSONL events + Chrome trace export.
+
+Every interesting transition in a ceremony — round head/tail, publish,
+RPC retry, quarantine, timeout, WAL replay, injected fault — is one
+JSON object with monotonic (``mono``) and wall (``ts``) timestamps and
+``ceremony_id``/``party``/``round`` identity fields.  Events land in a
+bounded in-memory ring (:class:`ObsLog`) and, when the ``DKG_TPU_OBSLOG``
+env knob names a directory, in one append-mode JSONL file per party so a
+chaos failure can be replayed from its logs alone.
+
+Redaction is structural, not best-effort: the recorder NEVER accepts
+share or key material — instrumentation sites only pass lengths, counts,
+indices, and error kinds — and as belt-and-braces every ``bytes`` value
+reaching :meth:`ObsLog.emit` is replaced by its length before
+serialization.  ``tests/test_obslog.py`` greps the emitted bytes of a
+live ceremony for the committee's secrets to prove it.
+
+Channel and fault code run deep inside transport internals where no
+recorder handle exists; they emit through a thread-local *ambient*
+recorder (:func:`use` / :func:`emit_current`) that ``run_party`` binds
+for the duration of its party thread.
+
+:func:`to_chrome_trace` merges any number of per-party logs into one
+Chrome/Perfetto trace-event JSON: one process per ceremony, one thread
+per party, ``phase_span`` spans as complete ("X") slices with
+``subtimings_s`` nested under them, and point events as instants.
+``scripts/trace_viz.py`` is the CLI wrapper.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Iterable
+
+from . import envknobs
+
+_TLS = threading.local()
+
+
+def _sanitize(value: Any) -> Any:
+    """Replace bytes payloads with their length, recursively.  The
+    instrumentation contract is lengths-only already; this makes an
+    accidental ``payload=raw`` emit a harmless ``"bytes:N"``."""
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        return f"bytes:{len(value)}"
+    if isinstance(value, dict):
+        return {str(k): _sanitize(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_sanitize(v) for v in value]
+    return value
+
+
+class ObsLog:
+    """Bounded ring of structured events with an optional JSONL file sink.
+
+    ``ceremony_id`` and ``party`` bind once at construction and stamp
+    every event; ``party`` is an int member index or ``"hub"``.
+    """
+
+    def __init__(
+        self,
+        capacity: int = 4096,
+        path: str | os.PathLike | None = None,
+        ceremony_id: str | None = None,
+        party: int | str | None = None,
+    ) -> None:
+        self.ceremony_id = ceremony_id
+        self.party = party
+        self._ring: deque[dict] = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._path = os.fspath(path) if path is not None else None
+        self._fh = open(self._path, "a", encoding="utf-8") if self._path else None
+
+    # -- recording ----------------------------------------------------------
+
+    def emit(self, kind: str, *, round: int | None = None, **fields) -> dict:
+        """Record one event; returns the event dict (tests poke at it)."""
+        ev: dict[str, Any] = {
+            "ts": time.time(),
+            "mono": time.monotonic(),
+            "kind": kind,
+        }
+        if self.ceremony_id is not None:
+            ev["ceremony_id"] = self.ceremony_id
+        if self.party is not None:
+            ev["party"] = self.party
+        if round is not None:
+            ev["round"] = round
+        for k, v in fields.items():
+            ev[k] = _sanitize(v)
+        with self._lock:
+            self._ring.append(ev)
+            if self._fh is not None:
+                self._fh.write(json.dumps(ev, sort_keys=True) + "\n")
+                self._fh.flush()
+        return ev
+
+    def emit_span(
+        self,
+        name: str,
+        *,
+        ts0: float,
+        mono0: float,
+        dur_s: float,
+        subs: dict[str, float] | None = None,
+        **fields,
+    ) -> dict:
+        """Record a completed span (``phase_span`` feeds these): start
+        timestamps, duration, and optional sub-phase seconds that the
+        trace export renders as nested slices."""
+        span_fields: dict[str, Any] = {
+            "name": name,
+            "ts0": ts0,
+            "mono0": mono0,
+            "dur_s": dur_s,
+        }
+        if subs:
+            span_fields["subs"] = {k: float(v) for k, v in subs.items()}
+        span_fields.update(fields)
+        return self.emit("span", **span_fields)
+
+    # -- access -------------------------------------------------------------
+
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    @property
+    def path(self) -> str | None:
+        return self._path
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    def __enter__(self) -> "ObsLog":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# -- ambient (thread-local) recorder ----------------------------------------
+
+
+class _Use:
+    """Context manager binding ``log`` as the calling thread's ambient
+    recorder; ``use(None)`` is a no-op binding (events are dropped)."""
+
+    def __init__(self, log: ObsLog | None) -> None:
+        self._log = log
+        self._prev: ObsLog | None = None
+
+    def __enter__(self) -> ObsLog | None:
+        self._prev = getattr(_TLS, "log", None)
+        _TLS.log = self._log
+        return self._log
+
+    def __exit__(self, *exc) -> None:
+        _TLS.log = self._prev
+
+
+def use(log: ObsLog | None) -> _Use:
+    return _Use(log)
+
+
+def current() -> ObsLog | None:
+    """The calling thread's ambient recorder, or None."""
+    return getattr(_TLS, "log", None)
+
+
+def emit_current(kind: str, *, round: int | None = None, **fields) -> dict | None:
+    """Emit into the ambient recorder if one is bound; else drop."""
+    log = current()
+    if log is None:
+        return None
+    return log.emit(kind, round=round, **fields)
+
+
+# -- construction helpers ----------------------------------------------------
+
+
+def ceremony_id_for(env) -> str:
+    """Deterministic short id for a ceremony Environment: all parties of
+    one ceremony derive the same id from the (group, n, t, commitment
+    key) tuple, so their logs merge onto one timeline."""
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=6)
+    h.update(env.group.name.encode())
+    h.update(f":{env.nr_members}:{env.threshold}:".encode())
+    h.update(env.group.encode(env.commitment_key.h))
+    return h.hexdigest()
+
+
+def from_env(
+    *,
+    ceremony_id: str | None = None,
+    party: int | str | None = None,
+    capacity: int = 4096,
+) -> ObsLog | None:
+    """An :class:`ObsLog` with a file sink under the ``DKG_TPU_OBSLOG``
+    directory, or None when the knob is unset.  File name is
+    ``{ceremony_id}-p{party:03d}.jsonl`` (``-hub.jsonl`` for the hub)."""
+    root = envknobs.string("DKG_TPU_OBSLOG", "flight-recorder log directory")
+    if root is None:
+        return None
+    os.makedirs(root, exist_ok=True)
+    cid = ceremony_id if ceremony_id is not None else "proc"
+    tag = f"p{party:03d}" if isinstance(party, int) else str(party or "proc")
+    path = os.path.join(root, f"{cid}-{tag}.jsonl")
+    return ObsLog(capacity=capacity, path=path, ceremony_id=ceremony_id, party=party)
+
+
+# -- timeline export ---------------------------------------------------------
+
+
+def load_jsonl(path: str | os.PathLike) -> list[dict]:
+    """Events from one JSONL log; malformed lines are skipped (a crash
+    mid-write must not poison the whole timeline)."""
+    out: list[dict] = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(ev, dict):
+                out.append(ev)
+    return out
+
+
+def _tid(ev: dict) -> int:
+    party = ev.get("party")
+    return party + 1 if isinstance(party, int) else 0
+
+
+def to_chrome_trace(events: Iterable[dict]) -> dict:
+    """Merge flight-recorder events (any number of parties/ceremonies)
+    into Chrome trace-event JSON (load via chrome://tracing or Perfetto).
+
+    Mapping: one *process* per ceremony_id, one *thread* per party (the
+    hub is tid 0); ``span`` events become complete ("X") slices with
+    their ``subs`` rendered as nested child slices laid out sequentially
+    from the parent's start; every other kind becomes an instant ("i").
+    Wall-clock timestamps align events across OS processes — parties of
+    one chaos restart run land on one coherent timeline.
+    """
+    events = [ev for ev in events if isinstance(ev, dict)]
+    if not events:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+
+    def wall0(ev: dict) -> float:
+        # spans carry their start time; point events their emit time
+        return ev.get("ts0", ev.get("ts", 0.0))
+
+    t0 = min(wall0(ev) for ev in events)
+    pids: dict[str, int] = {}
+    trace: list[dict] = []
+    for ev in events:
+        cid = str(ev.get("ceremony_id", "proc"))
+        if cid not in pids:
+            pids[cid] = len(pids) + 1
+            trace.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": pids[cid],
+                    "tid": 0,
+                    "args": {"name": f"ceremony {cid}"},
+                }
+            )
+        pid, tid = pids[cid], _tid(ev)
+        args = {
+            k: v
+            for k, v in ev.items()
+            if k
+            not in ("ts", "mono", "ts0", "mono0", "dur_s", "kind", "name",
+                    "ceremony_id", "party", "subs")
+        }
+        if ev.get("kind") == "span":
+            start_us = (wall0(ev) - t0) * 1e6
+            dur_us = float(ev.get("dur_s", 0.0)) * 1e6
+            trace.append(
+                {
+                    "name": str(ev.get("name", "span")),
+                    "ph": "X",
+                    "pid": pid,
+                    "tid": tid,
+                    "ts": start_us,
+                    "dur": dur_us,
+                    "args": args,
+                }
+            )
+            # nested sub-slices laid out back-to-back from the parent start
+            sub_ts = start_us
+            for sub, sec in (ev.get("subs") or {}).items():
+                sub_dur = float(sec) * 1e6
+                trace.append(
+                    {
+                        "name": f"{ev.get('name', 'span')}.{sub}",
+                        "ph": "X",
+                        "pid": pid,
+                        "tid": tid,
+                        "ts": sub_ts,
+                        "dur": sub_dur,
+                        "args": {},
+                    }
+                )
+                sub_ts += sub_dur
+        else:
+            trace.append(
+                {
+                    "name": str(ev.get("kind", "event")),
+                    "ph": "i",
+                    "pid": pid,
+                    "tid": tid,
+                    "ts": (wall0(ev) - t0) * 1e6,
+                    "s": "t",
+                    "args": args,
+                }
+            )
+    return {"traceEvents": trace, "displayTimeUnit": "ms"}

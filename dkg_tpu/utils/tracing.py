@@ -8,7 +8,11 @@ errors are the only signal).  Here observability is first-class:
   rendered as one JSON-able dict.
 * :func:`phase_span` — context manager timing one phase; nests under a
   trace and (optionally) a ``jax.profiler.TraceAnnotation`` so device
-  kernels show up named in TPU profiles.
+  kernels show up named in TPU profiles.  Every completed span also
+  observes the process-wide ``dkg_phase_seconds`` histogram
+  (:mod:`~dkg_tpu.utils.metrics`) and, when the calling thread has an
+  ambient flight recorder bound (:mod:`~dkg_tpu.utils.obslog`), emits a
+  span event carrying the sub-timings accumulated during the phase.
 * :func:`profile_to` — whole-ceremony ``jax.profiler`` capture helper.
 """
 
@@ -18,6 +22,8 @@ import contextlib
 import json
 import time
 from dataclasses import dataclass, field
+
+from . import metrics, obslog
 
 
 @dataclass
@@ -56,16 +62,39 @@ class CeremonyTrace:
         return {ph: units / s for ph, s in self.timings_s.items() if s > 0}
 
     def as_dict(self) -> dict:
-        return {
+        out = {
             "timings_s": dict(self.timings_s),
             "subtimings_s": {k: dict(v) for k, v in self.subtimings_s.items()},
             "total_s": self.total_s,
             "counters": dict(self.counters),
             "meta": dict(self.meta),
         }
+        units = self.meta.get("units")
+        if isinstance(units, (int, float)) and not isinstance(units, bool) and units > 0:
+            out["rates_per_s"] = self.rates(units)
+        return out
 
     def json(self) -> str:
         return json.dumps(self.as_dict(), sort_keys=True)
+
+
+# jax.profiler availability, probed once per process: None = unprobed,
+# False = unavailable, else the TraceAnnotation class.  phase_span runs
+# per round in tight loops; the per-span import-and-try was measurable
+# overhead and buried the one-time ImportError cost inside hot paths.
+_ANNOTATION_CLS = None
+
+
+def _annotation_cls():
+    global _ANNOTATION_CLS
+    if _ANNOTATION_CLS is None:
+        try:
+            import jax.profiler
+
+            _ANNOTATION_CLS = jax.profiler.TraceAnnotation
+        except Exception:  # pragma: no cover - profiler unavailable
+            _ANNOTATION_CLS = False
+    return _ANNOTATION_CLS
 
 
 @contextlib.contextmanager
@@ -74,17 +103,30 @@ def phase_span(trace: CeremonyTrace | None, phase: str, annotate_device: bool = 
     profiler available (no-op overhead otherwise)."""
     ann = contextlib.nullcontext()
     if annotate_device:
-        try:
-            import jax.profiler
-
-            ann = jax.profiler.TraceAnnotation(f"dkg/{phase}")
-        except Exception:  # pragma: no cover - profiler unavailable
-            pass
+        cls = _annotation_cls()
+        if cls:
+            ann = cls(f"dkg/{phase}")
+    recorder = obslog.current()
+    if recorder is not None and trace is not None:
+        subs0 = dict(trace.subtimings_s.get(phase) or {})
+    ts0 = time.time()
     t0 = time.perf_counter()
     with ann:
         yield
+    dt = time.perf_counter() - t0
     if trace is not None:
-        trace.record(phase, time.perf_counter() - t0)
+        trace.record(phase, dt)
+    metrics.REGISTRY.observe("dkg_phase_seconds", dt, phase=phase)
+    if recorder is not None:
+        subs = None
+        if trace is not None:
+            now = trace.subtimings_s.get(phase) or {}
+            subs = {
+                k: v - subs0.get(k, 0.0)
+                for k, v in now.items()
+                if v - subs0.get(k, 0.0) > 0
+            }
+        recorder.emit_span(phase, ts0=ts0, mono0=t0, dur_s=dt, subs=subs or None)
 
 
 @contextlib.contextmanager

@@ -24,6 +24,13 @@ set) are killed mid-round and re-spawned from their checkpoint WALs
 agreed master key — a restart consumes zero fault budget, which is the
 whole point of durable checkpointing (docs/fault_model.md, "Crash
 recovery").
+
+Set ``DKG_TPU_OBSLOG=<dir>`` to additionally write one flight-recorder
+JSONL per party per ceremony (committees get per-seed shared strings,
+so every run has a distinct ceremony_id); ``scripts/trace_viz.py`` over
+that directory renders the whole storm as one Chrome/Perfetto timeline
+(docs/observability.md).  The report embeds a process-wide metrics
+snapshot under ``"metrics"``.
 """
 
 from __future__ import annotations
@@ -46,6 +53,8 @@ from dkg_tpu.net.faults import (  # noqa: E402
     make_committee,
     run_with_faults,
 )
+from dkg_tpu.utils import obslog  # noqa: E402
+from dkg_tpu.utils.metrics import REGISTRY  # noqa: E402
 
 G = gh.RISTRETTO255
 
@@ -85,7 +94,11 @@ def random_plan(seed: int, n: int, t: int, timeout: float, restarts: int = 0) ->
 def run_one(
     seed: int, n: int, t: int, timeout: float, tcp: bool, restarts: int = 0
 ) -> dict:
-    env, keys, pks = make_committee(G, n, t, seed)
+    # per-seed shared string -> per-run commitment key -> distinct
+    # ceremony_id per storm run, so flight-recorder logs never collide
+    env, keys, pks = make_committee(
+        G, n, t, seed, shared_string=f"chaos-{seed:x}".encode()
+    )
     plan = random_plan(seed, n, t, timeout, restarts=restarts)
     hub = None
     ckpt = tempfile.TemporaryDirectory(prefix="dkg-wal-") if restarts else None
@@ -122,6 +135,7 @@ def run_one(
         }
         return {
             "seed": seed,
+            "ceremony_id": obslog.ceremony_id_for(env),
             "plan": plan.as_dict(),
             "wall_s": round(wall, 3),
             "outcomes": [
@@ -205,6 +219,7 @@ def run_storm(
         "survived": survived,
         "survival_rate": survived / ceremonies if ceremonies else None,
         "faults_injected": dict(sorted(fault_counts.items())),
+        "metrics": REGISTRY.snapshot(),
         "runs": runs,
     }
 

@@ -45,6 +45,13 @@ linter), so the committed baseline stays clean between CI runs:
         secret share material and must be persisted through
         ``net.checkpoint.PartyWal`` only (0600, fsync'd, checksummed,
         torn-tail tolerant; docs/fault_model.md "Crash recovery")
+* DKG006  (dkg_tpu/ only; scripts/tests exempt) ad-hoc telemetry: a bare
+        ``print()`` call, or a raw file write outside the sanctioned
+        writers (utils/obslog.py — the flight-recorder sink,
+        groups/precompute.py — the table cache, and dkg_tpu/net/ which
+        DKG005 already polices) — library telemetry goes through
+        ``utils.obslog`` / ``utils.metrics`` so events are structured,
+        redacted, and capturable (docs/observability.md)
 
 Exit 0 = clean.  Run: ``python scripts/lint_lite.py`` (from repo root).
 Also executed by tests/test_import_hygiene.py so the default test tier
@@ -115,6 +122,12 @@ _DIGEST_EAGER_ENTRYPOINTS = {"_compress_dev", "_tree_from_words"}
 # the oracle the vectorized paths are diffed against.
 _DIGEST_HOST_LEGS = {"_dealer_row_digests"}
 
+# Library modules sanctioned to write files directly (DKG006):
+# the flight-recorder JSONL sink and the persistent table cache.
+# dkg_tpu/net/ is excluded from DKG006's write check because DKG005
+# already polices it more strictly (WAL-only).
+_DKG006_WRITER_ALLOWLIST = {"obslog.py", "precompute.py"}
+
 
 class _Checker(ast.NodeVisitor):
     def __init__(self, path: pathlib.Path, tree: ast.Module, source: str):
@@ -128,6 +141,7 @@ class _Checker(ast.NodeVisitor):
         self._loop_depth = 0
         self._net_module = "dkg_tpu/net/" in path.as_posix()
         self._dkg_module = "dkg_tpu/dkg/" in path.as_posix()
+        self._pkg_module = "dkg_tpu/" in path.as_posix()
         self._dem_hot_module = (
             self._dkg_module and path.name in _DEM_HOT_MODULES
         )
@@ -253,6 +267,32 @@ class _Checker(ast.NodeVisitor):
     visit_DictComp = _visit_loop
     visit_GeneratorExp = _visit_loop
 
+    def _raw_write_name(self, node: ast.Call) -> str:
+        """The called name when ``node`` is a raw file write —
+        write-mode ``open()``, ``.write_bytes``/``.write_text``, or
+        fd-level ``os.open`` — else "" (shared by DKG005/DKG006)."""
+        func = node.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else ""
+        )
+        raw_write = name in ("write_bytes", "write_text")
+        if not raw_write and name == "open":
+            if isinstance(func, ast.Attribute):
+                recv = func.value
+                # fd-level os.open: a hand-rolled persistence path
+                raw_write = isinstance(recv, ast.Name) and recv.id == "os"
+            else:
+                mode = node.args[1] if len(node.args) >= 2 else None
+                for kw in node.keywords:
+                    if kw.arg == "mode":
+                        mode = kw.value
+                raw_write = (
+                    isinstance(mode, ast.Constant)
+                    and isinstance(mode.value, str)
+                    and any(c in mode.value for c in "wax+")
+                )
+        return name if raw_write else ""
+
     def visit_Call(self, node: ast.Call) -> None:
         # DKG001: net-layer decodes must route through the quarantine —
         # a raw decode_phase* call lets Byzantine bytes raise through
@@ -332,28 +372,8 @@ class _Checker(ast.NodeVisitor):
         # are not atomic, not fsync'd, not checksummed, and not 0600.
         # checkpoint.py itself is the sanctioned fd-level writer.
         if self._net_module and self.path.name != "checkpoint.py":
-            func = node.func
-            name = func.attr if isinstance(func, ast.Attribute) else (
-                func.id if isinstance(func, ast.Name) else ""
-            )
-            raw_write = name in ("write_bytes", "write_text")
-            if not raw_write and name == "open":
-                if isinstance(func, ast.Attribute):
-                    recv = func.value
-                    # fd-level os.open: any use outside the WAL is a
-                    # hand-rolled persistence path
-                    raw_write = isinstance(recv, ast.Name) and recv.id == "os"
-                else:
-                    mode = node.args[1] if len(node.args) >= 2 else None
-                    for kw in node.keywords:
-                        if kw.arg == "mode":
-                            mode = kw.value
-                    raw_write = (
-                        isinstance(mode, ast.Constant)
-                        and isinstance(mode.value, str)
-                        and any(c in mode.value for c in "wax+")
-                    )
-            if raw_write:
+            name = self._raw_write_name(node)
+            if name:
                 self._add(
                     node,
                     "DKG005",
@@ -361,6 +381,32 @@ class _Checker(ast.NodeVisitor):
                     "through net.checkpoint.PartyWal (atomic, fsync'd, "
                     "checksummed, 0600)",
                 )
+        # DKG006: no ad-hoc telemetry in library code — a bare print()
+        # anywhere in dkg_tpu/, or a raw file write outside the
+        # sanctioned writers (net/ is DKG005's stricter domain), must go
+        # through utils.obslog / utils.metrics instead.
+        if self._pkg_module:
+            func = node.func
+            if isinstance(func, ast.Name) and func.id == "print":
+                self._add(
+                    node,
+                    "DKG006",
+                    "print() in dkg_tpu/ — emit structured events via "
+                    "utils.obslog / counters via utils.metrics",
+                )
+            if (
+                not self._net_module
+                and self.path.name not in _DKG006_WRITER_ALLOWLIST
+            ):
+                name = self._raw_write_name(node)
+                if name:
+                    self._add(
+                        node,
+                        "DKG006",
+                        f"raw file write ({name}) in dkg_tpu/ — telemetry "
+                        "goes through utils.obslog (sanctioned writers: "
+                        "utils/obslog.py, groups/precompute.py)",
+                    )
         # DKG004b: a hashlib.blake2b call lexically inside a loop in a
         # batch hot module is a per-dealer host hash loop — use
         # crypto.blake2.blake2b_batch (one array op for all n lanes).

@@ -1,0 +1,93 @@
+"""Render flight-recorder JSONL logs as one Chrome/Perfetto trace.
+
+Merges any number of per-party event logs (written by ``run_party``
+and the TcpHub when ``DKG_TPU_OBSLOG`` names a directory) into a single
+Chrome trace-event JSON: one process per ceremony, one thread per
+party, ``phase_span`` phases as slices with their ``subtimings_s``
+nested underneath, and point events (publishes, quarantines, retries,
+injected faults) as instants.  Load the output via ``chrome://tracing``
+or https://ui.perfetto.dev.
+
+Usage::
+
+    DKG_TPU_OBSLOG=/tmp/obs python scripts/chaos_storm.py --restarts 2
+    python scripts/trace_viz.py /tmp/obs --out trace.json
+    python scripts/trace_viz.py /tmp/obs --ceremony bac988c776b7  # one run
+
+Arguments may be JSONL files, directories (every ``*.jsonl`` inside is
+merged), or a mix.  See docs/observability.md for the event schema.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from dkg_tpu.utils import obslog  # noqa: E402
+
+
+def collect_paths(args: list[str]) -> list[pathlib.Path]:
+    out: list[pathlib.Path] = []
+    for a in args:
+        p = pathlib.Path(a)
+        if p.is_dir():
+            out.extend(sorted(p.glob("*.jsonl")))
+        else:
+            out.append(p)
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "inputs", nargs="+",
+        help="JSONL log files and/or directories of them (DKG_TPU_OBSLOG dirs)",
+    )
+    ap.add_argument(
+        "--ceremony", default=None,
+        help="only include events of this ceremony_id (prefix match)",
+    )
+    ap.add_argument("--out", default="trace.json", help="output trace file")
+    args = ap.parse_args(argv)
+
+    paths = collect_paths(args.inputs)
+    if not paths:
+        print("trace_viz: no .jsonl logs found", file=sys.stderr)
+        return 1
+    events: list[dict] = []
+    for p in paths:
+        try:
+            events.extend(obslog.load_jsonl(p))
+        except OSError as exc:
+            print(f"trace_viz: skipping {p}: {exc}", file=sys.stderr)
+    if args.ceremony:
+        events = [
+            ev for ev in events
+            if str(ev.get("ceremony_id", "")).startswith(args.ceremony)
+        ]
+    if not events:
+        print("trace_viz: no events matched", file=sys.stderr)
+        return 1
+
+    trace = obslog.to_chrome_trace(events)
+    with open(args.out, "w", encoding="utf-8") as fh:
+        json.dump(trace, fh)
+    ceremonies = {str(ev.get("ceremony_id", "proc")) for ev in events}
+    parties = {(str(ev.get("ceremony_id")), ev.get("party")) for ev in events}
+    spans = sum(1 for ev in events if ev.get("kind") == "span")
+    print(
+        f"trace_viz: {len(events)} events from {len(paths)} log(s) -> "
+        f"{len(trace['traceEvents'])} trace events ({len(ceremonies)} "
+        f"ceremonies, {len(parties)} party timelines, {spans} spans) "
+        f"-> {args.out}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

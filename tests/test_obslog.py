@@ -1,0 +1,221 @@
+"""Flight-recorder tests: ring/sink semantics, ambient binding, the
+Chrome trace export, and the no-secrets-in-events redaction contract."""
+
+import json
+import threading
+
+import pytest
+
+from dkg_tpu.groups import host as gh
+from dkg_tpu.utils import obslog
+
+G = gh.RISTRETTO255
+
+
+def test_ring_is_bounded_and_ordered():
+    log = obslog.ObsLog(capacity=4)
+    for i in range(10):
+        log.emit("tick", i=i)
+    evs = log.events()
+    assert len(evs) == 4
+    assert [e["i"] for e in evs] == [6, 7, 8, 9]
+
+
+def test_events_carry_identity_and_both_clocks():
+    log = obslog.ObsLog(ceremony_id="abc123", party=7)
+    ev = log.emit("publish", round=2, bytes=128)
+    assert ev["ceremony_id"] == "abc123"
+    assert ev["party"] == 7
+    assert ev["round"] == 2
+    assert ev["kind"] == "publish"
+    assert ev["ts"] > 1e9  # wall clock
+    assert ev["mono"] > 0  # monotonic clock
+    # rounds are optional; identity fields still stamp
+    ev2 = log.emit("party_done", ok=True)
+    assert "round" not in ev2 and ev2["party"] == 7
+
+
+def test_bytes_values_are_sanitized_to_lengths():
+    log = obslog.ObsLog()
+    ev = log.emit(
+        "oops",
+        payload=b"\x00" * 33,
+        nested={"k": b"xy", "lst": [b"abc", 5]},
+    )
+    assert ev["payload"] == "bytes:33"
+    assert ev["nested"] == {"k": "bytes:2", "lst": ["bytes:3", 5]}
+
+
+def test_file_sink_writes_jsonl(tmp_path):
+    path = tmp_path / "log.jsonl"
+    with obslog.ObsLog(path=path, ceremony_id="cid", party=1) as log:
+        log.emit("a", x=1)
+        log.emit("b", x=2)
+    evs = obslog.load_jsonl(path)
+    assert [e["kind"] for e in evs] == ["a", "b"]
+    assert all(e["ceremony_id"] == "cid" for e in evs)
+
+
+def test_load_jsonl_skips_torn_lines(tmp_path):
+    path = tmp_path / "log.jsonl"
+    path.write_text('{"kind": "ok", "ts": 1.0}\n{"torn...\n\n{"kind": "ok2", "ts": 2.0}\n')
+    assert [e["kind"] for e in obslog.load_jsonl(path)] == ["ok", "ok2"]
+
+
+def test_from_env_unset_returns_none(monkeypatch):
+    monkeypatch.delenv("DKG_TPU_OBSLOG", raising=False)
+    assert obslog.from_env(ceremony_id="x", party=1) is None
+    # empty value is the shell idiom for unset (envknobs convention)
+    monkeypatch.setenv("DKG_TPU_OBSLOG", "")
+    assert obslog.from_env(ceremony_id="x", party=1) is None
+
+
+def test_from_env_names_files_per_party(monkeypatch, tmp_path):
+    monkeypatch.setenv("DKG_TPU_OBSLOG", str(tmp_path))
+    log = obslog.from_env(ceremony_id="deadbeef", party=3)
+    hub = obslog.from_env(party="hub")
+    try:
+        assert log.path.endswith("deadbeef-p003.jsonl")
+        assert hub.path.endswith("proc-hub.jsonl")
+    finally:
+        log.close()
+        hub.close()
+
+
+def test_ambient_recorder_is_thread_local():
+    log = obslog.ObsLog()
+    assert obslog.current() is None
+    assert obslog.emit_current("dropped") is None  # no-op without binding
+    with obslog.use(log):
+        assert obslog.current() is log
+        obslog.emit_current("seen", round=1)
+        seen_in_thread = []
+
+        def other():
+            seen_in_thread.append(obslog.current())
+
+        th = threading.Thread(target=other)
+        th.start()
+        th.join()
+        assert seen_in_thread == [None]  # binding does not leak across threads
+        with obslog.use(None):  # explicit no-op binding nests
+            assert obslog.current() is None
+            obslog.emit_current("swallowed")
+        assert obslog.current() is log
+    assert obslog.current() is None
+    assert [e["kind"] for e in log.events()] == ["seen"]
+
+
+def test_ceremony_id_is_deterministic_per_environment():
+    from dkg_tpu.net.faults import make_committee
+
+    env_a, _, _ = make_committee(G, 4, 1, seed=5, shared_string=b"run-a")
+    env_a2, _, _ = make_committee(G, 4, 1, seed=99, shared_string=b"run-a")
+    env_b, _, _ = make_committee(G, 4, 1, seed=5, shared_string=b"run-b")
+    assert obslog.ceremony_id_for(env_a) == obslog.ceremony_id_for(env_a2)
+    assert obslog.ceremony_id_for(env_a) != obslog.ceremony_id_for(env_b)
+
+
+def test_to_chrome_trace_spans_instants_and_nesting():
+    log = obslog.ObsLog(ceremony_id="cid", party=2)
+    log.emit("publish", round=1, bytes=64)
+    log.emit_span(
+        "net_round1", ts0=1000.0, mono0=5.0, dur_s=0.5,
+        subs={"digest": 0.2, "rho": 0.1},
+    )
+    doc = obslog.to_chrome_trace(log.events())
+    evs = doc["traceEvents"]
+    meta = [e for e in evs if e["ph"] == "M"]
+    assert meta and meta[0]["args"]["name"] == "ceremony cid"
+    spans = [e for e in evs if e["ph"] == "X"]
+    names = [s["name"] for s in spans]
+    assert names == ["net_round1", "net_round1.digest", "net_round1.rho"]
+    parent = spans[0]
+    assert parent["dur"] == pytest.approx(0.5e6)
+    # nested sub-slices sit inside the parent, laid out sequentially
+    assert spans[1]["ts"] == pytest.approx(parent["ts"])
+    assert spans[2]["ts"] == pytest.approx(parent["ts"] + 0.2e6)
+    assert spans[1]["dur"] + spans[2]["dur"] <= parent["dur"] + 1e-6
+    instants = [e for e in evs if e["ph"] == "i"]
+    assert [i["name"] for i in instants] == ["publish"]
+    assert instants[0]["args"]["round"] == 1
+    # parties map to distinct tids; hub events map to tid 0
+    assert parent["tid"] == 3
+    json.dumps(doc)  # serializable as-is
+
+
+def test_to_chrome_trace_merges_ceremonies_into_processes():
+    a = obslog.ObsLog(ceremony_id="aaa", party=1)
+    b = obslog.ObsLog(ceremony_id="bbb", party=1)
+    a.emit("publish", round=1)
+    b.emit("publish", round=1)
+    doc = obslog.to_chrome_trace(a.events() + b.events())
+    pids = {e["pid"] for e in doc["traceEvents"] if e["ph"] == "i"}
+    assert len(pids) == 2
+
+
+# ---------------------------------------------------------------------------
+# live-ceremony instrumentation + the redaction contract
+# ---------------------------------------------------------------------------
+
+
+def _secret_spellings(value: int) -> set[bytes]:
+    """Every plausible byte spelling of a secret scalar: 32-byte
+    big-endian hex (upper/lower) and plain decimal."""
+    hx = format(value, "064x")
+    return {hx.encode(), hx.upper().encode(), str(value).encode()}
+
+
+def test_live_ceremony_logs_events_and_never_secret_bytes(monkeypatch, tmp_path):
+    """The acceptance contract: a real faulted ceremony with the file
+    sink armed produces per-party JSONL with the expected event kinds,
+    and NO byte spelling of any communication secret key or final share
+    appears anywhere in the emitted logs."""
+    from dkg_tpu.net.channel import InProcessChannel
+    from dkg_tpu.net.faults import FaultPlan, make_committee, run_with_faults
+
+    monkeypatch.setenv("DKG_TPU_OBSLOG", str(tmp_path))
+    n, t, seed = 4, 1, 0x0B5106
+    env, keys, pks = make_committee(G, n, t, seed, shared_string=b"obslog-redact")
+    plan = FaultPlan(seed).garbage(1, sender=2).restart(3, 2)
+    chan = InProcessChannel()
+    ckpt = tmp_path / "wal"
+    ckpt.mkdir()
+    results = run_with_faults(
+        env, keys, pks, plan, lambda i: chan,
+        timeout=2.0, seed=seed, checkpoint_dir=str(ckpt),
+    )
+    assert all(getattr(r, "ok", False) for r in results)
+
+    cid = obslog.ceremony_id_for(env)
+    logs = sorted(tmp_path.glob("*.jsonl"))
+    assert [p.name for p in logs] == [f"{cid}-p{i:03d}.jsonl" for i in range(1, n + 1)]
+
+    events = [ev for p in logs for ev in obslog.load_jsonl(p)]
+    kinds = {ev["kind"] for ev in events}
+    assert {
+        "round_head", "round_tail", "publish", "span", "party_done",
+        "quarantine", "fault_injected", "wal_record", "wal_resume",
+    } <= kinds
+    assert all(ev["ceremony_id"] == cid for ev in events)
+    # the restarted party's log shows the injected restart and resume
+    p3 = obslog.load_jsonl(tmp_path / f"{cid}-p003.jsonl")
+    assert any(
+        ev["kind"] == "fault_injected" and ev["fault"] == "restart" for ev in p3
+    )
+    assert any(ev["kind"] == "wal_resume" for ev in p3)
+    # and the whole run renders to a valid chrome trace
+    doc = obslog.to_chrome_trace(events)
+    assert any(e["ph"] == "X" for e in doc["traceEvents"])
+    json.dumps(doc)
+
+    # -- redaction: grep raw emitted bytes for every known secret -------
+    secrets: set[bytes] = set()
+    for k in keys:
+        secrets.update(_secret_spellings(k.sk))
+    for r in results:
+        secrets.update(_secret_spellings(r.share.value))
+    blob = b"".join(p.read_bytes() for p in logs)
+    assert blob  # the grep below must not pass vacuously
+    for s in secrets:
+        assert s not in blob

@@ -49,6 +49,71 @@ def test_record_sub_accumulates_outside_phase_totals():
     json.loads(tr.json())  # serializable
 
 
+def test_record_sub_never_leaks_into_total_even_without_phase():
+    # sub-timings for a phase that has NO timings_s entry still must not
+    # contribute to total_s (the invariant rates() depends on)
+    tr = CeremonyTrace()
+    tr.record_sub("verify", "msm", 3.0)
+    assert tr.total_s == 0.0
+    tr.record("verify", 1.0)
+    assert tr.total_s == 1.0
+    assert tr.subtimings_s["verify"]["msm"] == 3.0
+
+
+def test_as_dict_rates_follow_units_meta_hint():
+    tr = CeremonyTrace()
+    tr.record("deal", 2.0)
+    tr.record("verify", 0.5)
+    # no hint -> no rates key (legacy consumers see the same dict)
+    assert "rates_per_s" not in tr.as_dict()
+    tr.meta["units"] = 100
+    d = tr.as_dict()
+    assert d["rates_per_s"] == {"deal": 50.0, "verify": 200.0}
+    # non-numeric / non-positive / bool hints never produce rates
+    for bogus in ("100", 0, -5, True):
+        tr.meta["units"] = bogus
+        assert "rates_per_s" not in tr.as_dict()
+
+
+def test_trace_json_round_trips_losslessly():
+    tr = CeremonyTrace()
+    tr.record("deal", 1.5)
+    tr.record_sub("deal", "seal", 0.25)
+    tr.bump("pairs_sealed", 9)
+    tr.meta["units"] = 12
+    assert json.loads(tr.json()) == tr.as_dict()
+
+
+def test_phase_span_profiler_probe_is_cached():
+    from dkg_tpu.utils import tracing
+
+    tr = CeremonyTrace()
+    with phase_span(tr, "warm"):  # first span primes the probe
+        pass
+    probed = tracing._ANNOTATION_CLS
+    assert probed is not None  # probe ran exactly once and stuck
+    with phase_span(tr, "second"):
+        pass
+    assert tracing._ANNOTATION_CLS is probed
+
+
+def test_phase_span_feeds_process_metrics():
+    from dkg_tpu.utils.metrics import REGISTRY
+
+    tr = CeremonyTrace()
+    before = (
+        REGISTRY.snapshot()["histograms"]
+        .get('dkg_phase_seconds{phase="metrics_probe"}', {})
+        .get("count", 0)
+    )
+    with phase_span(tr, "metrics_probe", annotate_device=False):
+        pass
+    after = REGISTRY.snapshot()["histograms"][
+        'dkg_phase_seconds{phase="metrics_probe"}'
+    ]["count"]
+    assert after == before + 1
+
+
 def test_derive_rho_records_digest_subtimings():
     """derive_rho splits the fiat_shamir span into digest/rho sub-spans
     and records which digest leg ran.  Identity-point commitment tensors
