@@ -66,6 +66,29 @@ class CeremonyConfig:
         """Bit width of party indices 1..n."""
         return max(int(self.n).bit_length(), 1)
 
+    def padded(self, n_pad: int, t_pad: int) -> "CeremonyConfig":
+        """The shape-bucketed twin of this config: same curve, lanes
+        padded to ``(n_pad, t_pad)`` so many ceremonies of nearby shapes
+        share ONE set of jitted executables (dkg_tpu.service).
+
+        Pad-and-mask contract: the caller zero-pads the coefficient
+        tensors (phantom dealers are all-zero polynomials; real dealers
+        gain zero high-order coefficients).  Zero coefficients deal zero
+        shares and identity commitments, and every round-1 kernel is
+        lane-elementwise along the dealer axis, so the REAL lanes of the
+        padded run are bit-identical to the unpadded run — proven by the
+        padded-vs-unpadded oracle tests (tests/test_service.py) on both
+        curves.  Phantom dealers must be masked out of ``qualified``
+        before aggregation/master-key (adding their zero shares is a
+        no-op, but they are not protocol participants).
+        """
+        if n_pad < self.n or t_pad < self.t:
+            raise ValueError(
+                f"padded({n_pad}, {t_pad}): bucket must dominate the real "
+                f"shape (n={self.n}, t={self.t})"
+            )
+        return CeremonyConfig(self.curve, n_pad, t_pad)
+
 
 # ---------------------------------------------------------------------------
 # round-1 dealing kernels

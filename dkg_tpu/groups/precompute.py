@@ -27,6 +27,13 @@ doublings — all doubling work was hoisted into the precomputation.
 ``stats()`` exposes build/load counters and seconds so callers
 (utils/tracing.py CeremonyTrace, bench.py's ``warm`` flag) can attribute
 table-build cost vs steady-state cost.
+
+Concurrency: both caches are guarded by one process-wide build lock, so
+N threads warming the same curve's tables (the multi-tenant service's
+workers all start by asking for g/h) serialize into exactly ONE
+build/load; the rest are ``proc_hits``.  Disk writes stay atomic
+(temp + ``os.replace``) so concurrent *processes* can still race only
+into identical, validly-digested files.
 """
 
 from __future__ import annotations
@@ -35,6 +42,7 @@ import hashlib
 import os
 import pathlib
 import tempfile
+import threading
 import time
 
 import jax
@@ -50,6 +58,14 @@ _FORMAT_VERSION = 1
 _TABLES: dict = {}
 # in-process host-table cache (the persisted artifact): same key -> ndarray
 _HOST: dict = {}
+
+# Build-once discipline for concurrent warmers: N service workers (or
+# party threads) asking for the same table must produce ONE build/load —
+# without this, every thread that misses the dict races into its own
+# multi-second comb build and the last writer wins.  One re-entrant lock
+# (base_table -> host_table nests) is enough: builds are rare and
+# cache hits only pay an uncontended acquire.
+_BUILD_LOCK = threading.RLock()
 
 _STATS = {
     "builds": 0,  # host tables computed from scratch
@@ -170,25 +186,26 @@ def host_table(
     delegates to), so swapping call sites is bit-exact.
     """
     ck = (cs.name, key, window)
-    hit = _HOST.get(ck)
-    if hit is not None:
-        _STATS["proc_hits"] += 1
-        return hit
-    t0 = time.perf_counter()
-    table = _load_disk(cs, key, window)
-    if table is not None:
-        _STATS["disk_loads"] += 1
-        _STATS["load_s"] += time.perf_counter() - t0
-    else:
+    with _BUILD_LOCK:
+        hit = _HOST.get(ck)
+        if hit is not None:
+            _STATS["proc_hits"] += 1
+            return hit
         t0 = time.perf_counter()
-        # the undecorated builder: gd's lru_cache would double-count
-        # memory and hide rebuilds from the counters
-        table = gd._fixed_table_np.__wrapped__(cs, key, window)
-        _STATS["builds"] += 1
-        _STATS["build_s"] += time.perf_counter() - t0
-        _persist(cs, key, window, table)
-    _HOST[ck] = table
-    return table
+        table = _load_disk(cs, key, window)
+        if table is not None:
+            _STATS["disk_loads"] += 1
+            _STATS["load_s"] += time.perf_counter() - t0
+        else:
+            t0 = time.perf_counter()
+            # the undecorated builder: gd's lru_cache would double-count
+            # memory and hide rebuilds from the counters
+            table = gd._fixed_table_np.__wrapped__(cs, key, window)
+            _STATS["builds"] += 1
+            _STATS["build_s"] += time.perf_counter() - t0
+            _persist(cs, key, window, table)
+        _HOST[ck] = table
+        return table
 
 
 def _default_window() -> int:
@@ -225,20 +242,21 @@ def base_table(cs: gd.CurveSpec, base, window: int | None = None) -> jax.Array:
         window = _default_window()
     key = gd.base_key(cs, base)
     ck = (cs.name, key, window)
-    hit = _TABLES.get(ck)
-    if hit is not None:
-        _STATS["proc_hits"] += 1
-        return hit
-    if window > 8:
-        half = window // 2
-        if window % 2 or half > 8 or 16 % window:
-            raise ValueError(f"unsupported fixed-base window width {window}")
-        t_half = jnp.asarray(host_table(cs, key, half))
-        table = gd.affine_canon(cs, gd._compose_table_dev(cs, t_half, window))
-    else:
-        table = jnp.asarray(host_table(cs, key, window))
-    _TABLES[ck] = table
-    return table
+    with _BUILD_LOCK:
+        hit = _TABLES.get(ck)
+        if hit is not None:
+            _STATS["proc_hits"] += 1
+            return hit
+        if window > 8:
+            half = window // 2
+            if window % 2 or half > 8 or 16 % window:
+                raise ValueError(f"unsupported fixed-base window width {window}")
+            t_half = jnp.asarray(host_table(cs, key, half))
+            table = gd.affine_canon(cs, gd._compose_table_dev(cs, t_half, window))
+        else:
+            table = jnp.asarray(host_table(cs, key, window))
+        _TABLES[ck] = table
+        return table
 
 
 def generator_table(cs: gd.CurveSpec, window: int | None = None) -> jax.Array:

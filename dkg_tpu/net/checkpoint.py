@@ -72,6 +72,14 @@ def wal_path(directory: Union[str, os.PathLike], index: int) -> pathlib.Path:
     return pathlib.Path(directory) / f"party{index:04d}.wal"
 
 
+def service_wal_path(directory: Union[str, os.PathLike]) -> pathlib.Path:
+    """Canonical WAL location for a ceremony-service journal
+    (dkg_tpu.service.durable) under ``directory``.  One journal per
+    server process — scheduler appends are already serialized, and a
+    single file makes kill-and-restart recovery a single replay."""
+    return pathlib.Path(directory) / "service.wal"
+
+
 class PartyWal:
     """Append-only, checksummed, fsync'd record log at ``path``.
 

@@ -1,0 +1,48 @@
+"""Multi-tenant ceremony service: many concurrent DKGs, one warm runtime.
+
+The production shape of "heavy traffic from millions of users" is not
+one giant ceremony — it is thousands of small/medium ceremonies
+(per-group threshold keys, per-session signing committees) arriving as
+traffic.  This package turns the batched engine (dkg.ceremony) into a
+service:
+
+* :mod:`~dkg_tpu.service.buckets` — the shape-bucketing policy: every
+  requested ``(n, t)`` is padded up to a small ladder of canonical
+  shapes so the jit compile cache hits instead of compiling one program
+  set per distinct committee size.
+* :mod:`~dkg_tpu.service.engine` — the warm execution lane: shared
+  precompute tables, pad-and-mask execution of single ceremonies, and a
+  *stacked* lane that vmaps whole convoys of same-bucket ceremonies over
+  a leading ceremony axis.
+* :mod:`~dkg_tpu.service.scheduler` — the admission queue and worker
+  pool: bounded queue with reject-on-full (503) backpressure,
+  per-ceremony deadlines, convoy formation, a two-deep start/finish
+  pipeline generalizing ``seal_shares_pipeline``'s host/device overlap,
+  and optional WAL-backed durability.
+* :mod:`~dkg_tpu.service.durable` — per-ceremony WAL journaling
+  (reusing :class:`~dkg_tpu.net.checkpoint.PartyWal`) so a restarted
+  server resumes in-flight ceremonies.
+
+Entry points: :class:`~dkg_tpu.service.scheduler.CeremonyScheduler`,
+:class:`~dkg_tpu.service.engine.CeremonyRequest`.  Knobs (all through
+``utils.envknobs``): ``DKG_TPU_SERVICE_CONCURRENCY``,
+``DKG_TPU_SERVICE_QUEUE_DEPTH``, ``DKG_TPU_SERVICE_BATCH_MAX``,
+``DKG_TPU_SERVICE_DEADLINE_S``, ``DKG_TPU_SERVICE_WAL_DIR``.
+See docs/service.md for the architecture and the bucketing/backpressure
+semantics, and scripts/fleet_bench.py for the throughput benchmark.
+"""
+
+from .buckets import Bucket, bucket_for, split_widths
+from .engine import CeremonyOutcome, CeremonyRequest, WarmRuntime
+from .scheduler import CeremonyScheduler, QueueFullError
+
+__all__ = [
+    "Bucket",
+    "bucket_for",
+    "split_widths",
+    "CeremonyOutcome",
+    "CeremonyRequest",
+    "WarmRuntime",
+    "CeremonyScheduler",
+    "QueueFullError",
+]

@@ -1,0 +1,156 @@
+"""WAL-backed ceremony durability: a restarted server resumes traffic.
+
+One journal per server process (``net.checkpoint.service_wal_path``),
+built on :class:`~dkg_tpu.net.checkpoint.PartyWal` — the same
+append-only, checksummed, fsync'd, torn-tail-tolerant record log the
+party runtime checkpoints into, so the service inherits its crash
+semantics for free.
+
+Two record kinds, both JSON bodies with a ``kind`` tag:
+
+* ``req`` — appended at ADMISSION, before submit() returns the ceremony
+  id.  Carries the full :class:`~dkg_tpu.service.engine.CeremonyRequest`
+  (durable requests must be seeded: the journal stores the seed, not
+  the coefficients, and the re-dealt polynomials are byte-identical by
+  the engine's deterministic draw order).
+* ``done`` — appended at COMPLETION (any terminal status: done, failed,
+  expired).  Carries the PUBLIC outcome only — master key, qualified
+  set, complaints.  Share material NEVER touches the journal; a
+  recovered terminal ceremony re-serves its public result, while its
+  secret shares live only in the process that ran it.
+
+Recovery (:meth:`ServiceJournal.replay`) partitions replayed ids into
+*pending* (req without done — resubmitted and re-run from the seed) and
+*terminal* (req+done — their outcomes re-served directly).  The
+scheduler compacts the journal on recovery via ``PartyWal.rewrite`` so
+a torn tail never shadows post-restart appends.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+
+from ..net.checkpoint import PartyWal, service_wal_path
+from .engine import CeremonyOutcome, CeremonyRequest
+
+__all__ = ["ServiceJournal", "service_wal_path"]
+
+
+def _req_body(cid: str, seq: int, req: CeremonyRequest) -> bytes:
+    return json.dumps(
+        {
+            "kind": "req",
+            "id": cid,
+            "seq": seq,
+            "curve": req.curve,
+            "n": req.n,
+            "t": req.t,
+            "shared_string": base64.b64encode(req.shared_string).decode(),
+            "seed": req.seed,
+            "rho_bits": req.rho_bits,
+            "deadline_s": req.deadline_s,
+            "tag": req.tag,
+        },
+        sort_keys=True,
+    ).encode()
+
+
+def _done_body(out: CeremonyOutcome) -> bytes:
+    return json.dumps(
+        {
+            "kind": "done",
+            "id": out.ceremony_id,
+            "status": out.status,
+            "curve": out.curve,
+            "n": out.n,
+            "t": out.t,
+            "bucket_n": out.bucket_n,
+            "bucket_t": out.bucket_t,
+            "master": out.master.hex(),
+            "qualified": list(out.qualified),
+            "complaints": [list(c) for c in out.complaints],
+            "error": out.error,
+        },
+        sort_keys=True,
+    ).encode()
+
+
+class ServiceJournal:
+    """The scheduler's durability sink.  All writes happen under the
+    scheduler's own locks (admission lock for ``record_request``, the
+    completing worker for ``record_done``), so the journal itself needs
+    no locking beyond PartyWal's single-write appends."""
+
+    def __init__(self, directory) -> None:
+        self.wal = PartyWal(service_wal_path(directory))
+
+    def record_request(self, cid: str, seq: int, req: CeremonyRequest) -> None:
+        self.wal.append(_req_body(cid, seq, req))
+
+    def record_done(self, out: CeremonyOutcome) -> None:
+        self.wal.append(_done_body(out))
+
+    def replay(self):
+        """(pending, terminal): ``pending`` maps ceremony id ->
+        ``(seq, CeremonyRequest)`` for admitted-but-unfinished
+        ceremonies; ``terminal`` maps id -> public
+        :class:`CeremonyOutcome`.  Unparseable bodies are skipped (the
+        frame checksum already passed, so these are version skew, not
+        corruption — better to recover the rest than refuse)."""
+        pending: dict = {}
+        terminal: dict = {}
+        for body in self.wal.replay():
+            try:
+                rec = json.loads(body)
+                kind = rec["kind"]
+            except (ValueError, KeyError):
+                continue
+            if kind == "req":
+                try:
+                    req = CeremonyRequest(
+                        curve=rec["curve"],
+                        n=rec["n"],
+                        t=rec["t"],
+                        shared_string=base64.b64decode(rec["shared_string"]),
+                        seed=rec["seed"],
+                        rho_bits=rec["rho_bits"],
+                        deadline_s=rec["deadline_s"],
+                        durable=True,
+                        tag=rec.get("tag", ""),
+                    )
+                except (KeyError, ValueError):
+                    continue
+                pending[rec["id"]] = (rec.get("seq", 0), req)
+            elif kind == "done":
+                cid = rec.get("id")
+                if cid is None:
+                    continue
+                pending.pop(cid, None)
+                terminal[cid] = CeremonyOutcome(
+                    ceremony_id=cid,
+                    status=rec.get("status", "done"),
+                    curve=rec.get("curve", ""),
+                    n=rec.get("n", 0),
+                    t=rec.get("t", 0),
+                    bucket_n=rec.get("bucket_n", 0),
+                    bucket_t=rec.get("bucket_t", 0),
+                    master=bytes.fromhex(rec.get("master", "")),
+                    qualified=tuple(rec.get("qualified", ())),
+                    complaints=tuple(
+                        tuple(c) for c in rec.get("complaints", ())
+                    ),
+                    error=rec.get("error", ""),
+                )
+        return pending, terminal
+
+    def compact(self, pending: dict, terminal: dict) -> None:
+        """Rewrite the journal to exactly the replayed state (pending
+        reqs + terminal dones — a ``done`` record is self-contained, so
+        terminal ceremonies need no ``req`` twin), dropping any torn
+        tail so post-restart appends cannot be shadowed by it."""
+        bodies = [
+            _req_body(cid, seq, req) for cid, (seq, req) in pending.items()
+        ]
+        bodies.extend(_done_body(out) for out in terminal.values())
+        self.wal.rewrite(bodies)

@@ -1,0 +1,490 @@
+"""Warm execution lane: pad-and-mask ceremonies over shared runtime state.
+
+One process serves MANY ceremonies, so everything shape- or
+curve-dependent is shared and warm:
+
+* fixed-base tables come from :mod:`dkg_tpu.groups.precompute` (one
+  process-wide cache, persisted to disk) via :class:`WarmRuntime`, which
+  additionally caches the per-``shared_string`` Pedersen commitment key
+  and its ``h`` table;
+* every request's ``(n, t)`` is padded to its :func:`~dkg_tpu.service.
+  buckets.bucket_for` bucket, so all requests in a bucket reuse ONE set
+  of jitted executables (the compile cache is keyed by static shape);
+* same-bucket requests stack on a leading *ceremony axis* and run
+  through vmapped twins of the round kernels (``_deal_stack`` etc.) —
+  the kernels in dkg.ceremony are already array-shaped, so stacking is
+  a natural lift that amortizes per-dispatch overhead across the convoy
+  (the dominant cost for small committees on CPU/single-core hosts).
+
+Bit-exactness: phantom lanes are zero-coefficient dealers (zero shares,
+identity commitments) and every round-1 kernel is elementwise along the
+dealer/ceremony axes, so a real lane's outputs — wire bytes included —
+are bit-identical whether it runs unpadded, padded, or stacked
+(tests/test_service.py oracle tests, both curves).  The Fiat-Shamir
+randomizers ``rho`` DO differ between the padded and unpadded legs (the
+transcript digest binds the padded tensors); that changes only which
+random linear combination checks the same set of pair equations, never
+the dealt values, the qualified set on honest runs, or the master key.
+
+The start/finish split (:func:`start_convoy` / :func:`finish_convoy`)
+generalizes ``hybrid_batch.seal_shares_pipeline``'s overlap trick to
+whole ceremonies: ``start`` only *dispatches* device work (JAX dispatch
+is asynchronous), so a scheduler worker can start convoy k+1 before
+doing convoy k's host-side transcript/DEM work under the device's
+dispatch shadow.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import hashlib
+import random
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto.commitment import CommitmentKey
+from ..dkg import ceremony as ce
+from ..fields import host as fh
+from ..groups import device as gd
+from ..groups import host as gh
+from ..groups import precompute as gp
+from . import buckets
+
+#: Default domain-separation string for service ceremonies (requests may
+#: override; the commitment key h derives from it).
+DEFAULT_SHARED_STRING = b"dkg-tpu-service"
+
+
+@dataclasses.dataclass(frozen=True)
+class CeremonyRequest:
+    """One ceremony-as-a-service request.
+
+    ``seed`` pins the coefficient stream (``random.Random(seed)``, drawn
+    in exactly :class:`~dkg_tpu.dkg.ceremony.BatchedCeremony`'s order) so
+    results are reproducible and WAL replay after a crash re-deals
+    byte-identical polynomials; ``None`` uses ``random.SystemRandom``
+    (non-durable requests only).  ``deadline_s`` is a relative budget
+    from admission; a ceremony past its deadline is EXPIRED rather than
+    started (and rather than *finished*, if it expires mid-flight).
+    """
+
+    curve: str
+    n: int
+    t: int
+    shared_string: bytes = DEFAULT_SHARED_STRING
+    seed: int | None = None
+    rho_bits: int = 128
+    deadline_s: float | None = None
+    durable: bool = False
+    tag: str = ""
+
+    def bucket(self) -> buckets.Bucket:
+        return buckets.bucket_for(self.n, self.t)
+
+    def convoy_key(self) -> tuple:
+        """Requests sharing this key may stack into one convoy: same
+        curve, bucket, randomizer width and commitment key."""
+        b = self.bucket()
+        return (self.curve, b.n, b.t, self.rho_bits, self.shared_string)
+
+
+def request_id(req: CeremonyRequest, seq: int = 0) -> str:
+    """Deterministic short ceremony id: request identity + admission
+    sequence number (submitting the same request twice is two
+    ceremonies).  Mirrors obslog.ceremony_id_for's blake2b-48 shape."""
+    h = hashlib.blake2b(digest_size=6)
+    h.update(
+        f"{req.curve}|{req.n}|{req.t}|{req.seed}|{req.rho_bits}|{seq}|".encode()
+    )
+    h.update(req.shared_string)
+    return h.hexdigest()
+
+
+@dataclasses.dataclass
+class CeremonyOutcome:
+    """Public result of one ceremony.  ``master`` is the canonical
+    encoded master public key; ``final_shares`` (secret!) stays in
+    process memory only — the durability journal persists everything
+    here EXCEPT it (dkg_tpu.service.durable)."""
+
+    ceremony_id: str
+    status: str  # "done" | "failed"
+    curve: str = ""
+    n: int = 0
+    t: int = 0
+    bucket_n: int = 0
+    bucket_t: int = 0
+    master: bytes = b""
+    qualified: tuple = ()
+    complaints: tuple = ()
+    error: str = ""
+    #: engine wall-clock attributed to this ceremony: its convoy's
+    #: runtime divided by the convoy width
+    seconds: float = 0.0
+    #: time.monotonic() stamp set by the scheduler when the outcome was
+    #: recorded — lets clients compute queue-to-completion latency
+    completed_at: float = 0.0
+    final_shares: np.ndarray | None = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+
+
+class WarmRuntime:
+    """Shared warm state for all ceremonies in a process: fixed-base
+    tables (via groups.precompute's process+disk cache) and per
+    ``(curve, shared_string)`` commitment keys.  Thread-safe; every
+    scheduler worker holds one reference."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._ck: dict = {}
+
+    def commitment(self, curve: str, shared_string: bytes):
+        """(CommitmentKey, g_table, h_table) for a ceremony environment,
+        cached.  The g table is shared curve-wide; h derives from the
+        shared string."""
+        key = (curve, shared_string)
+        with self._lock:
+            hit = self._ck.get(key)
+        if hit is not None:
+            return hit
+        cs = gd.ALL_CURVES[curve]
+        group = gh.ALL_GROUPS[curve]
+        ck = CommitmentKey.generate(group, shared_string)
+        # precompute has its own build-once lock; taking self._lock over
+        # these (multi-second, possibly-compiling) builds would serialize
+        # unrelated curves behind one warmer
+        g_table = gp.generator_table(cs)
+        h_table = gp.base_table(cs, ck.h)
+        entry = (ck, g_table, h_table)
+        with self._lock:
+            self._ck.setdefault(key, entry)
+        return entry
+
+    def warmup(self, req: CeremonyRequest, widths: tuple = (1,)) -> None:
+        """Compile the request's bucket programs ahead of traffic by
+        running one throwaway convoy per width (results discarded)."""
+        for w in widths:
+            reqs = [
+                dataclasses.replace(req, seed=(req.seed or 0) + i)
+                for i in range(w)
+            ]
+            finish_convoy(self, start_convoy(self, reqs))
+
+
+# ---------------------------------------------------------------------------
+# stacked (ceremony-axis) twins of the round kernels
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _deal_stack(cfg, coeffs_a, coeffs_b, g_table, h_table):
+    """(k, n, t+1, L) coefficient stacks -> stacked round-1 tensors."""
+
+    def one(ca, cb):
+        return ce.deal(cfg, ca, cb, g_table, h_table)
+
+    return jax.vmap(one)(coeffs_a, coeffs_b)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 5))
+def _verify_stack(cfg, e_comm, shares, hidings, rho, rho_bits, g_table, h_table):
+    def one(e1, s1, r1, rho1):
+        return ce.verify_batch(cfg, e1, s1, r1, rho1, rho_bits, g_table, h_table)
+
+    return jax.vmap(one)(e_comm, shares, hidings, rho)
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _finalise_stack(cfg, a_comm, shares, qualified):
+    def one(a1, s1, q1):
+        return (
+            ce.aggregate_shares(cfg, s1, q1),
+            ce.master_key_from_bare(cfg, a1, q1),
+        )
+
+    return jax.vmap(one)(a_comm, shares, qualified)
+
+
+# ---------------------------------------------------------------------------
+# coefficient drawing + padding
+# ---------------------------------------------------------------------------
+
+
+def draw_coeffs(cfg: ce.CeremonyConfig, rng) -> tuple[np.ndarray, np.ndarray]:
+    """The REAL coefficient tensors, drawn in exactly
+    :class:`~dkg_tpu.dkg.ceremony.BatchedCeremony`'s order so a seeded
+    service ceremony and a fresh single-ceremony run of the same seed
+    deal byte-identical polynomials."""
+    fs = cfg.cs.scalar
+    n, t = cfg.n, cfg.t
+    a = fh.encode(fs, [[fs.rand_int(rng) for _ in range(t + 1)] for _ in range(n)])
+    b = fh.encode(fs, [[fs.rand_int(rng) for _ in range(t + 1)] for _ in range(n)])
+    return a, b
+
+
+def pad_coeffs(coeffs: np.ndarray, n_pad: int, t_pad: int) -> np.ndarray:
+    """Zero-pad a real ``(n, t+1, L)`` coefficient tensor to the bucket
+    shape ``(n_pad, t_pad+1, L)``: phantom dealers are all-zero
+    polynomials, real dealers gain zero high-order coefficients — both
+    inert under the pad-and-mask contract."""
+    n, tc, limbs = coeffs.shape
+    out = np.zeros((n_pad, t_pad + 1, limbs), np.uint32)
+    out[:n, :tc] = coeffs
+    return out
+
+
+def rng_for(req: CeremonyRequest):
+    if req.seed is None:
+        return random.SystemRandom()
+    return random.Random(req.seed)
+
+
+def derive_rho_convoy(
+    cfg: ce.CeremonyConfig, a, e, s, r, rho_bits: int
+) -> np.ndarray:
+    """Per-ceremony Fiat-Shamir randomizers for a whole convoy, (k, n,
+    L) — bit-identical to calling :func:`dkg_tpu.dkg.ceremony.
+    derive_rho` on each ceremony's slice.
+
+    The transcript row digests are per-dealer and row-independent, so
+    the convoy's (k, n, ...) tensors fold into ONE (k*n, ...) row-digest
+    pass — one dispatch per tensor family instead of 3*k — and only the
+    outer fold (3 small arrays through one blake2b) stays per ceremony.
+    This is the digest's share of the dispatch amortization that makes
+    the stacked lane pay: per-ceremony digest calls were ~40% of a small
+    convoy's wall clock.
+    """
+    k, n = s.shape[0], s.shape[1]
+    if k == 1:
+        return ce.derive_rho(cfg, a[0], e[0], s[0], r[0], rho_bits)[None]
+    rows_a, rows_e, rows_sr = ce._dealer_rows_device(
+        cfg,
+        np.reshape(a, (k * n,) + a.shape[2:]),
+        np.reshape(e, (k * n,) + e.shape[2:]),
+        np.reshape(s, (k * n,) + s.shape[2:]),
+        np.reshape(r, (k * n,) + r.shape[2:]),
+    )
+    rows_a = np.asarray(rows_a).reshape(k, n, -1)
+    rows_e = np.asarray(rows_e).reshape(k, n, -1)
+    rows_sr = np.asarray(rows_sr).reshape(k, n, -1)
+    return np.stack(
+        [
+            ce.fiat_shamir_rho(
+                cfg,
+                ce._fold_digest_device(cfg, rows_a[i], rows_e[i], rows_sr[i]),
+                rho_bits,
+            )
+            for i in range(k)
+        ]
+    )
+
+
+# ---------------------------------------------------------------------------
+# convoy execution: start (device dispatch) / finish (host + device tail)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class InFlight:
+    """A dispatched convoy: device round-1 tensors not yet consumed."""
+
+    reqs: list
+    ids: list
+    cfg_pad: ce.CeremonyConfig
+    g_table: jax.Array
+    h_table: jax.Array
+    a: jax.Array  # (k, n_pad, t_pad+1, C, L)
+    e: jax.Array
+    s: jax.Array  # (k, n_pad, n_pad, L)
+    r: jax.Array
+
+
+def start_convoy(
+    runtime: WarmRuntime, reqs: list, ids: list | None = None
+) -> InFlight:
+    """Draw + pad coefficients for a same-key convoy and *dispatch* the
+    stacked deal.  Returns without blocking on device work (width-1
+    convoys reuse the plain :func:`dkg_tpu.dkg.ceremony.deal`
+    executable; wider convoys use the vmapped twin)."""
+    key = reqs[0].convoy_key()
+    if any(r.convoy_key() != key for r in reqs):
+        raise ValueError("start_convoy: mixed convoy keys")
+    req0 = reqs[0]
+    b = req0.bucket()
+    cfg_pad = ce.CeremonyConfig(req0.curve, req0.n, req0.t).padded(b.n, b.t)
+    _, g_table, h_table = runtime.commitment(req0.curve, req0.shared_string)
+    ca, cb = [], []
+    for req in reqs:
+        cfg_real = ce.CeremonyConfig(req.curve, req.n, req.t)
+        a_real, b_real = draw_coeffs(cfg_real, rng_for(req))
+        ca.append(pad_coeffs(a_real, b.n, b.t))
+        cb.append(pad_coeffs(b_real, b.n, b.t))
+    if len(reqs) == 1:
+        a, e, s, r = ce.deal(
+            cfg_pad, jnp.asarray(ca[0]), jnp.asarray(cb[0]), g_table, h_table
+        )
+        a, e, s, r = a[None], e[None], s[None], r[None]
+    else:
+        a, e, s, r = _deal_stack(
+            cfg_pad, jnp.asarray(np.stack(ca)), jnp.asarray(np.stack(cb)),
+            g_table, h_table,
+        )
+    if ids is None:
+        ids = [request_id(req, i) for i, req in enumerate(reqs)]
+    return InFlight(list(reqs), list(ids), cfg_pad, g_table, h_table, a, e, s, r)
+
+
+def finish_convoy(runtime: WarmRuntime, fl: InFlight) -> list[CeremonyOutcome]:
+    """Host transcript work + stacked verify/finalise for a dispatched
+    convoy.  The first ``np.asarray`` blocks on the deal dispatched by
+    :func:`start_convoy` — everything before this call overlaps it."""
+    del runtime  # tables travel on the InFlight
+    cfg_pad = fl.cfg_pad
+    k = len(fl.reqs)
+    n_pad = cfg_pad.n
+    rho_bits = fl.reqs[0].rho_bits
+    a_h, e_h = np.asarray(fl.a), np.asarray(fl.e)
+    s_h, r_h = np.asarray(fl.s), np.asarray(fl.r)
+    rho = derive_rho_convoy(cfg_pad, a_h, e_h, s_h, r_h, rho_bits)
+    if k == 1:
+        ok = ce.verify_batch(
+            cfg_pad, fl.e[0], fl.s[0], fl.r[0], jnp.asarray(rho[0]), rho_bits,
+            fl.g_table, fl.h_table,
+        )[None]
+    else:
+        ok = _verify_stack(
+            cfg_pad, fl.e, fl.s, fl.r, jnp.asarray(rho), rho_bits,
+            fl.g_table, fl.h_table,
+        )
+    ok_h = np.asarray(ok)
+
+    qualified = np.zeros((k, n_pad), bool)
+    complaints: list[list[tuple[int, int]]] = [[] for _ in range(k)]
+    errors: list[str] = [""] * k
+    for i, req in enumerate(fl.reqs):
+        qualified[i, : req.n] = True
+        if not ok_h[i, : req.n].all():
+            # rare blame path, per ceremony: the engine holds the
+            # plaintext share matrix, so re-checking IS adjudication
+            # (mirrors BatchedCeremony.run)
+            pw = np.asarray(
+                ce.verify_pairwise(
+                    cfg_pad, fl.e[i], fl.s[i], fl.r[i], fl.g_table, fl.h_table
+                )
+            )[: req.n, : req.n]
+            guilty = ~pw.all(axis=1)
+            complaints[i] = [
+                (int(rcp) + 1, int(dlr) + 1) for dlr, rcp in zip(*np.nonzero(~pw))
+            ]
+            qualified[i, : req.n] = ~guilty
+            if int(guilty.sum()) > req.t:
+                errors[i] = "MISBEHAVIOUR_HIGHER_THRESHOLD"
+
+    if k == 1:
+        # width-1 lanes reuse the plain executables (shared with
+        # BatchedCeremony and the rest of the suite's compile cache)
+        q0 = jnp.asarray(qualified[0])
+        final_shares = ce.aggregate_shares(cfg_pad, fl.s[0], q0)[None]
+        master = ce.master_key_from_bare(cfg_pad, fl.a[0], q0)[None]
+    else:
+        final_shares, master = _finalise_stack(
+            cfg_pad, fl.a, fl.s, jnp.asarray(qualified)
+        )
+    shares_h = np.asarray(final_shares)
+    master_enc = gd.encode_batch(cfg_pad.cs, np.asarray(master))
+
+    out = []
+    for i, req in enumerate(fl.reqs):
+        failed = bool(errors[i])
+        out.append(
+            CeremonyOutcome(
+                ceremony_id=fl.ids[i],
+                status="failed" if failed else "done",
+                curve=req.curve,
+                n=req.n,
+                t=req.t,
+                bucket_n=cfg_pad.n,
+                bucket_t=cfg_pad.t,
+                master=b"" if failed else master_enc[i].tobytes(),
+                qualified=tuple(bool(q) for q in qualified[i, : req.n]),
+                complaints=tuple(complaints[i]),
+                error=errors[i],
+                final_shares=None if failed else shares_h[i, : req.n],
+            )
+        )
+    return out
+
+
+def run_convoy(runtime: WarmRuntime, reqs: list) -> list[CeremonyOutcome]:
+    """start + finish in one call (the unpipelined entry point)."""
+    return finish_convoy(runtime, start_convoy(runtime, reqs))
+
+
+def run_single_reference(req: CeremonyRequest) -> bytes:
+    """A FRESH unpadded single-ceremony run of ``req`` (the oracle the
+    service legs are compared against): BatchedCeremony with the same
+    seeded rng, master key canonically encoded."""
+    c = ce.BatchedCeremony(
+        req.curve, req.n, req.t, req.shared_string, rng_for(req)
+    )
+    out = c.run(rho_bits=req.rho_bits)
+    if "master" not in out:
+        raise RuntimeError(f"reference ceremony failed: {out.get('error')}")
+    cs = c.cfg.cs
+    return gd.encode_batch(cs, np.asarray(out["master"])[None])[0].tobytes()
+
+
+# ---------------------------------------------------------------------------
+# wire-format leg (padded KEM/DEM, real-lane slice)
+# ---------------------------------------------------------------------------
+
+
+def wire_broadcasts(
+    runtime: WarmRuntime,
+    req: CeremonyRequest,
+    fl: InFlight,
+    lane: int,
+    pks: list,
+    rng_enc,
+) -> list[bytes]:
+    """Wire-format ``BroadcastPhase1`` bytes for one convoy lane, sealed
+    to the ``req.n`` recipient communication keys ``pks``.
+
+    The KEM runs at the BUCKET shape so it shares executables with every
+    other ceremony in the bucket: encryption randomness is drawn for the
+    real ``(n, n)`` block (same draw order as the unpadded leg) and
+    padded with ones, phantom recipient keys with the generator — then
+    the real sub-block of the sealed output is packaged.  Byte-identical
+    to the unpadded ``seal_shares_pipeline`` leg (oracle test)."""
+    from ..dkg.hybrid_batch import broadcasts_from_batch, seal_shares_pipeline
+    from ..utils import serde
+
+    cfg_pad = fl.cfg_pad
+    cs = cfg_pad.cs
+    fs = cs.scalar
+    group = gh.ALL_GROUPS[req.curve]
+    n, n_pad = req.n, cfg_pad.n
+    r_real = fh.encode(
+        fs, [[fs.rand_int(rng_enc) for _ in range(n)] for _ in range(n)]
+    )
+    r_pad = np.zeros((n_pad, n_pad, fs.limbs), np.uint32)
+    r_pad[..., 0] = 1  # phantom lanes: r=1 (a zero KEM scalar has no inverse)
+    r_pad[:n, :n] = r_real
+    pks_dev = gd.from_host(cs, list(pks) + [group.generator()] * (n_pad - n))
+    sealed = seal_shares_pipeline(
+        group, cfg_pad, np.asarray(fl.s[lane]), np.asarray(fl.r[lane]),
+        pks_dev, jnp.asarray(r_pad), fl.g_table,
+    )
+    real_rows = [row[:n] for row in sealed[:n]]
+    # slice the coefficient axis too: a real dealer's padded high
+    # coefficients are commitments to zero (identity points) that the
+    # unpadded wire message does not carry
+    bcasts = broadcasts_from_batch(
+        group, cfg_pad, np.asarray(fl.e[lane])[:n, : req.t + 1], real_rows
+    )
+    return [serde.encode_phase1(group, b) for b in bcasts]

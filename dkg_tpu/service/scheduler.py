@@ -1,0 +1,428 @@
+"""Admission queue + worker pool: the multi-tenant ceremony front door.
+
+Concurrency model — THREADS, not asyncio, and deliberately so: the
+work units are JAX dispatches (release the GIL inside XLA), host
+transcript digests (hashlib releases the GIL), and numpy transfers —
+all of which overlap fine under threads, while an asyncio design would
+have to push every one of those blocking calls to an executor *anyway*
+(JAX has no awaitable dispatch API) and would gain nothing but an event
+loop to babysit.  The pool is the ONE sanctioned thread-spawn site in
+this package (scripts/lint_lite.py DKG007); everything else in
+``dkg_tpu/service/`` must stay thread-free so the concurrency story has
+a single owner.
+
+Flow:
+
+* :meth:`CeremonyScheduler.submit` admits a request into a BOUNDED
+  queue — full queue raises :class:`QueueFullError` immediately (the
+  HTTP mapping is 503 + Retry-After; see examples/serve.py).  Admission
+  is the durability point: with a WAL dir configured, the request
+  record is fsync'd before submit returns the ceremony id.
+* workers pop *convoys*: the queue head plus up to ``batch_max - 1``
+  more QUEUED requests sharing its convoy key (curve, bucket, rho_bits,
+  shared string), truncated to the width ladder so only ladder-width
+  programs ever compile.  Same-bucket traffic thus amortizes one
+  dispatch across the whole convoy — on hosts where per-op dispatch
+  overhead dominates small ceremonies, this is where the throughput is.
+* each worker runs a TWO-DEEP pipeline generalizing
+  ``hybrid_batch.seal_shares_pipeline``: it *starts* (dispatches) convoy
+  k+1 before *finishing* (host transcript + verify + finalise) convoy
+  k, so host work rides under the device's dispatch shadow.
+* deadlines are enforced at pop (an expired ceremony never starts) and
+  at finish (a ceremony that expired mid-flight reports ``expired``,
+  not ``done``).
+
+Knobs (all validated through utils.envknobs; constructor arguments
+win): ``DKG_TPU_SERVICE_CONCURRENCY`` (workers, default 4),
+``DKG_TPU_SERVICE_QUEUE_DEPTH`` (admission bound, default 256),
+``DKG_TPU_SERVICE_BATCH_MAX`` (max convoy width, default 8, capped by
+the bucket ladder), ``DKG_TPU_SERVICE_DEADLINE_S`` (default per-request
+deadline, unset = none), ``DKG_TPU_SERVICE_WAL_DIR`` (durability
+journal directory, unset = durability off).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..utils import envknobs
+from ..utils.metrics import REGISTRY
+from . import buckets
+from .durable import ServiceJournal
+from .engine import (
+    CeremonyOutcome,
+    CeremonyRequest,
+    WarmRuntime,
+    finish_convoy,
+    request_id,
+    start_convoy,
+)
+
+
+class QueueFullError(RuntimeError):
+    """Admission queue at capacity — the caller should back off and
+    retry (HTTP 503).  Raised instead of blocking: a DKG client can
+    retry cheaply, while an unbounded queue turns overload into
+    unbounded latency for everyone already queued."""
+
+
+class _Pending:
+    __slots__ = ("cid", "seq", "req", "deadline_at")
+
+    def __init__(self, cid, seq, req, deadline_at):
+        self.cid = cid
+        self.seq = seq
+        self.req = req
+        self.deadline_at = deadline_at
+
+
+class CeremonyScheduler:
+    """Bounded-admission ceremony scheduler over one warm runtime.
+
+    Use as a context manager or call :meth:`close`.  Thread-safe: any
+    thread may submit/poll/result concurrently.
+    """
+
+    def __init__(
+        self,
+        *,
+        concurrency: int | None = None,
+        queue_depth: int | None = None,
+        batch_max: int | None = None,
+        deadline_s: float | None = None,
+        wal_dir: str | None = None,
+        runtime: WarmRuntime | None = None,
+        metrics=REGISTRY,
+    ) -> None:
+        if concurrency is None:
+            concurrency = envknobs.pos_int(
+                "DKG_TPU_SERVICE_CONCURRENCY", "scheduler worker threads"
+            ) or 4
+        if queue_depth is None:
+            queue_depth = envknobs.pos_int(
+                "DKG_TPU_SERVICE_QUEUE_DEPTH", "admission queue bound"
+            ) or 256
+        if batch_max is None:
+            batch_max = envknobs.pos_int(
+                "DKG_TPU_SERVICE_BATCH_MAX", "max stacked-convoy width"
+            ) or buckets.WIDTHS[0]
+        if deadline_s is None:
+            deadline_s = envknobs.pos_float(
+                "DKG_TPU_SERVICE_DEADLINE_S", "default per-ceremony deadline"
+            )
+        if wal_dir is None:
+            wal_dir = envknobs.string(
+                "DKG_TPU_SERVICE_WAL_DIR", "service durability journal directory"
+            )
+        self.concurrency = concurrency
+        self.queue_depth = queue_depth
+        self.batch_max = min(batch_max, buckets.WIDTHS[0])
+        self.default_deadline_s = deadline_s
+        self.runtime = runtime if runtime is not None else WarmRuntime()
+        self.metrics = metrics
+        self._cond = threading.Condition()
+        self._queue: list[_Pending] = []
+        self._results: dict[str, CeremonyOutcome] = {}
+        self._status: dict[str, str] = {}
+        self._seq = 0
+        self._running = True
+        self._draining = False
+        self._journal = ServiceJournal(wal_dir) if wal_dir else None
+        if self._journal is not None:
+            self._recover()
+        # the one sanctioned thread-spawn site in dkg_tpu/service/
+        # (lint DKG007): daemon so a crashed main thread never hangs on
+        # ceremony workers
+        self._workers = [
+            threading.Thread(
+                target=self._worker, name=f"dkg-svc-{i}", daemon=True
+            )
+            for i in range(concurrency)
+        ]
+        for w in self._workers:
+            w.start()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close(drain=not any(exc))
+
+    def close(self, drain: bool = True) -> None:
+        """Stop the workers.  ``drain`` finishes everything already
+        admitted first; otherwise still-queued ceremonies complete as
+        ``failed`` with a shutdown error (durable ones stay pending in
+        the journal and are resubmitted on the next recovery)."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+            if drain:
+                while self._queue:
+                    self._cond.wait(timeout=0.1)
+            self._running = False
+            dropped = list(self._queue)
+            self._queue.clear()
+            for p in dropped:
+                # durable drops are NOT journalled as done: they stay
+                # pending in the WAL and the next recovery resubmits them
+                self._finish_one(
+                    CeremonyOutcome(
+                        ceremony_id=p.cid,
+                        status="failed",
+                        curve=p.req.curve,
+                        n=p.req.n,
+                        t=p.req.t,
+                        error="SHUTDOWN",
+                    ),
+                )
+            self._cond.notify_all()
+        for w in self._workers:
+            w.join(timeout=60)
+
+    def _recover(self) -> None:
+        """Replay the journal: re-serve terminal outcomes, resubmit
+        pending (admitted-but-unfinished) ceremonies under their
+        original ids, and compact the log."""
+        pending, terminal = self._journal.replay()
+        self._journal.compact(pending, terminal)
+        for cid, out in terminal.items():
+            self._results[cid] = out
+            self._status[cid] = out.status
+        now = time.monotonic()
+        for cid, (seq, req) in pending.items():
+            self._seq = max(self._seq, seq + 1)
+            deadline = (
+                now + req.deadline_s if req.deadline_s is not None else None
+            )
+            self._queue.append(_Pending(cid, seq, req, deadline))
+            self._status[cid] = "queued"
+        self.metrics.set_gauge("service_queue_depth", len(self._queue))
+        if pending:
+            self.metrics.inc("service_recovered_total", len(pending))
+
+    # -- client surface -----------------------------------------------------
+
+    def submit(self, req: CeremonyRequest) -> str:
+        """Admit a ceremony; returns its id or raises
+        :class:`QueueFullError` (backpressure) / ``ValueError`` (bad
+        request — including unbucketable shapes and unseeded durable
+        requests, both rejected before touching the queue)."""
+        buckets.bucket_for(req.n, req.t)  # validates; raises ValueError
+        if req.durable and req.seed is None:
+            raise ValueError(
+                "durable ceremonies must be seeded: the journal replays "
+                "the seed, not the coefficients"
+            )
+        if req.durable and self._journal is None:
+            raise ValueError(
+                "durable ceremony submitted but the scheduler has no WAL "
+                "dir (DKG_TPU_SERVICE_WAL_DIR / wal_dir=)"
+            )
+        deadline_s = (
+            req.deadline_s
+            if req.deadline_s is not None
+            else self.default_deadline_s
+        )
+        with self._cond:
+            if not self._running or self._draining:
+                raise QueueFullError("scheduler is shutting down")
+            if len(self._queue) >= self.queue_depth:
+                self.metrics.inc("service_rejected_total")
+                raise QueueFullError(
+                    f"admission queue full ({self.queue_depth})"
+                )
+            seq = self._seq
+            self._seq += 1
+            cid = request_id(req, seq)
+            if req.durable:
+                self._journal.record_request(cid, seq, req)
+            deadline_at = (
+                time.monotonic() + deadline_s if deadline_s is not None else None
+            )
+            self._queue.append(_Pending(cid, seq, req, deadline_at))
+            self._status[cid] = "queued"
+            self.metrics.inc("service_submitted_total")
+            self.metrics.set_gauge("service_queue_depth", len(self._queue))
+            self._cond.notify()
+        return cid
+
+    def poll(self, cid: str) -> str:
+        """Current status: queued | running | done | failed | expired —
+        or ``unknown`` for an id this scheduler never admitted."""
+        with self._cond:
+            return self._status.get(cid, "unknown")
+
+    def result(self, cid: str, timeout: float | None = None) -> CeremonyOutcome:
+        """Block until ``cid`` reaches a terminal status and return its
+        outcome (TimeoutError on timeout, KeyError for unknown ids)."""
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        with self._cond:
+            if cid not in self._status:
+                raise KeyError(f"unknown ceremony id {cid!r}")
+            while cid not in self._results:
+                remain = None
+                if deadline is not None:
+                    remain = deadline - time.monotonic()
+                    if remain <= 0:
+                        raise TimeoutError(
+                            f"ceremony {cid} still {self._status[cid]}"
+                        )
+                self._cond.wait(timeout=remain)
+            return self._results[cid]
+
+    # -- worker side --------------------------------------------------------
+
+    def _pop_convoy(self, block: bool) -> list[_Pending] | None:
+        """Head-of-queue convoy: the oldest QUEUED request plus up to
+        ``batch_max - 1`` others sharing its convoy key, truncated to
+        the largest ladder width that fits (never phantom-padded).
+        Returns None when idle (non-blocking) or shut down."""
+        with self._cond:
+            while True:
+                if not self._running or (self._draining and not self._queue):
+                    return None
+                expired = [
+                    p
+                    for p in self._queue
+                    if p.deadline_at is not None
+                    and time.monotonic() > p.deadline_at
+                ]
+                for p in expired:
+                    self._queue.remove(p)
+                    self._finish_one(
+                        CeremonyOutcome(
+                            ceremony_id=p.cid,
+                            status="expired",
+                            curve=p.req.curve,
+                            n=p.req.n,
+                            t=p.req.t,
+                            error="DEADLINE_EXCEEDED",
+                        ),
+                        durable=p.req.durable,
+                    )
+                if self._queue:
+                    break
+                if not block:
+                    return None
+                self._cond.wait(timeout=0.2)
+            head = self._queue[0]
+            key = head.req.convoy_key()
+            mates = [p for p in self._queue if p.req.convoy_key() == key]
+            cap = min(self.batch_max, buckets.width_cap(head.req.bucket()))
+            width = next(
+                w for w in buckets.WIDTHS if w <= min(len(mates), cap)
+            )
+            convoy = mates[:width]
+            for p in convoy:
+                self._queue.remove(p)
+                self._status[p.cid] = "running"
+            self.metrics.set_gauge("service_queue_depth", len(self._queue))
+            self.metrics.inc("service_convoys_total")
+            self._cond.notify_all()
+            return convoy
+
+    def _worker(self) -> None:
+        inflight = None  # (convoy, InFlight, t_start)
+        while True:
+            convoy = self._pop_convoy(block=inflight is None)
+            if convoy is not None:
+                t0 = time.monotonic()
+                try:
+                    fl = start_convoy(
+                        self.runtime,
+                        [p.req for p in convoy],
+                        [p.cid for p in convoy],
+                    )
+                except Exception as exc:  # noqa: BLE001 — worker must survive
+                    self._fail_convoy(convoy, exc)
+                    continue
+                if inflight is not None:
+                    self._finish(*inflight)
+                inflight = (convoy, fl, t0)
+                continue
+            if inflight is not None:
+                self._finish(*inflight)
+                inflight = None
+                continue
+            with self._cond:
+                if not self._running or (self._draining and not self._queue):
+                    return
+
+    def _finish(self, convoy, fl, t0) -> None:
+        try:
+            outcomes = finish_convoy(self.runtime, fl)
+        except Exception as exc:  # noqa: BLE001 — worker must survive
+            self._fail_convoy(convoy, exc)
+            return
+        dt = time.monotonic() - t0
+        # per-ceremony attribution: a width-w convoy's wall clock is
+        # shared by w ceremonies (the whole-convoy time goes to the
+        # service_convoy_seconds histogram below)
+        share = dt / max(1, len(convoy))
+        for p, out in zip(convoy, outcomes):
+            out.seconds = share
+            if (
+                p.deadline_at is not None
+                and time.monotonic() > p.deadline_at
+            ):
+                out = CeremonyOutcome(
+                    ceremony_id=out.ceremony_id,
+                    status="expired",
+                    curve=out.curve,
+                    n=out.n,
+                    t=out.t,
+                    error="DEADLINE_EXCEEDED",
+                    seconds=share,
+                )
+            with self._cond:
+                self._finish_one(out, durable=p.req.durable)
+        self.metrics.observe(
+            "service_convoy_seconds", dt, width=str(len(convoy))
+        )
+
+    def _fail_convoy(self, convoy, exc) -> None:
+        with self._cond:
+            for p in convoy:
+                self._finish_one(
+                    CeremonyOutcome(
+                        ceremony_id=p.cid,
+                        status="failed",
+                        curve=p.req.curve,
+                        n=p.req.n,
+                        t=p.req.t,
+                        error=f"{type(exc).__name__}: {exc}",
+                    ),
+                    durable=p.req.durable,
+                )
+
+    def _finish_one(
+        self,
+        out: CeremonyOutcome,
+        durable: bool = False,
+    ) -> None:
+        """Record a terminal outcome.  Journal the public outcome for
+        durable ceremonies so recovery re-serves instead of re-running.
+        The condition's lock is reentrant, so callers already holding it
+        just re-enter."""
+        if durable and self._journal is not None:
+            self._journal.record_done(out)
+        with self._cond:
+            self._record(out)
+
+    def _record(self, out: CeremonyOutcome) -> None:
+        out.completed_at = time.monotonic()
+        self._results[out.ceremony_id] = out
+        self._status[out.ceremony_id] = out.status
+        self.metrics.inc("service_completed_total", status=out.status)
+        if out.seconds:
+            # bucket label, not ceremony_id: a server runs unboundedly
+            # many ceremonies and histogram series must stay bounded
+            # (per-ceremony attribution goes through obslog/tracing)
+            self.metrics.observe(
+                "service_ceremony_seconds", out.seconds,
+                bucket=f"{out.bucket_n}x{out.bucket_t}" if out.bucket_n else "none",
+            )
+        self._cond.notify_all()

@@ -13,7 +13,12 @@ DKG_TPU_MXU via fields.matmul, DKG_TPU_TABLE_CACHE via
 groups.precompute, DKG_TPU_NET_* transport knobs via net.channel,
 DKG_TPU_CHECKPOINT_DIR via net.checkpoint,
 DKG_TPU_DIGEST via crypto.device_hash.digest_dispatch,
-DKG_TPU_OBSLOG flight-recorder log directory via utils.obslog).
+DKG_TPU_OBSLOG flight-recorder log directory via utils.obslog,
+DKG_TPU_SERVICE_CONCURRENCY / DKG_TPU_SERVICE_QUEUE_DEPTH /
+DKG_TPU_SERVICE_BATCH_MAX / DKG_TPU_SERVICE_DEADLINE_S /
+DKG_TPU_SERVICE_WAL_DIR scheduler knobs via service.scheduler —
+lint rule DKG007 bans any other environment access in
+dkg_tpu/service/).
 
 An EMPTY value is everywhere treated as unset: ``DKG_TPU_X= cmd`` is
 the shell idiom for clearing a knob on one invocation, and must select

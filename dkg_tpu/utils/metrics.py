@@ -38,13 +38,22 @@ DEFAULT_BUCKETS = (
 
 
 def _labelitems(labels: dict) -> tuple:
-    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+    return tuple(
+        sorted((str(k), str(v)) for k, v in labels.items() if v is not None)
+    )
+
+
+def _escape(value: str) -> str:
+    """Prometheus label-value escaping (backslash, double quote, newline)
+    — a ceremony_id or error-kind label must never be able to break the
+    exposition format, whatever bytes it carries."""
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
 def _series(name: str, labelitems: tuple) -> str:
     if not labelitems:
         return name
-    inner = ",".join(f'{k}="{v}"' for k, v in labelitems)
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in labelitems)
     return f"{name}{{{inner}}}"
 
 
@@ -164,9 +173,18 @@ class MetricsRegistry:
 REGISTRY = MetricsRegistry()
 
 
-def observe_trace(trace, registry: MetricsRegistry | None = None) -> None:
+def observe_trace(
+    trace,
+    registry: MetricsRegistry | None = None,
+    ceremony_id: str | None = None,
+) -> None:
     """Feed one :class:`~dkg_tpu.utils.tracing.CeremonyTrace` (phases,
     sub-phases, protocol counters) into the registry.
+
+    ``ceremony_id`` labels every emitted series so M concurrent
+    ceremonies (dkg_tpu.service) keep distinct series instead of
+    clobbering one another; ``None`` (single-tenant callers: bench,
+    chaos_storm) keeps the unlabeled legacy series.
 
     For traces assembled OUTSIDE ``phase_span`` (e.g. bench.py builds one
     from child-process timings): spans that ran through ``phase_span``
@@ -174,25 +192,41 @@ def observe_trace(trace, registry: MetricsRegistry | None = None) -> None:
     a trace double-counts the phase histogram.
     """
     reg = registry if registry is not None else REGISTRY
+    cid = ceremony_id
     for phase, seconds in trace.timings_s.items():
-        reg.observe("dkg_phase_seconds", seconds, phase=phase)
+        reg.observe("dkg_phase_seconds", seconds, phase=phase, ceremony_id=cid)
     for phase, subs in trace.subtimings_s.items():
         for sub, seconds in subs.items():
-            reg.observe("dkg_subphase_seconds", seconds, phase=phase, sub=sub)
+            reg.observe(
+                "dkg_subphase_seconds", seconds, phase=phase, sub=sub,
+                ceremony_id=cid,
+            )
     for counter, value in trace.counters.items():
-        reg.inc("dkg_ceremony_counter_total", value, counter=counter)
-    reg.inc("dkg_ceremonies_total")
+        reg.inc(
+            "dkg_ceremony_counter_total", value, counter=counter, ceremony_id=cid
+        )
+    reg.inc("dkg_ceremonies_total", ceremony_id=cid)
 
 
-def observe_party_result(result, registry: MetricsRegistry | None = None) -> None:
+def observe_party_result(
+    result,
+    registry: MetricsRegistry | None = None,
+    ceremony_id: str | None = None,
+) -> None:
     """Feed one finished :class:`~dkg_tpu.net.party.PartyResult`'s
     transport/robustness counters into the registry (called by
-    ``net.party`` at the end of every ``run_party``)."""
+    ``net.party`` at the end of every ``run_party``).  ``ceremony_id``
+    labels every series when given (multi-tenant callers)."""
     reg = registry if registry is not None else REGISTRY
-    reg.inc("dkg_parties_total", outcome="ok" if result.ok else "error")
-    reg.inc("dkg_party_quarantined_total", result.quarantined)
-    reg.inc("dkg_party_round_timeouts_total", result.timeouts)
-    reg.inc("dkg_party_rpc_retries_total", result.retries)
-    reg.inc("dkg_party_resumes_total", result.resumes)
-    reg.inc("dkg_wal_records_total", result.wal_records)
-    reg.inc("dkg_wal_replayed_rounds_total", result.replayed_rounds)
+    cid = ceremony_id
+    reg.inc(
+        "dkg_parties_total",
+        outcome="ok" if result.ok else "error",
+        ceremony_id=cid,
+    )
+    reg.inc("dkg_party_quarantined_total", result.quarantined, ceremony_id=cid)
+    reg.inc("dkg_party_round_timeouts_total", result.timeouts, ceremony_id=cid)
+    reg.inc("dkg_party_rpc_retries_total", result.retries, ceremony_id=cid)
+    reg.inc("dkg_party_resumes_total", result.resumes, ceremony_id=cid)
+    reg.inc("dkg_wal_records_total", result.wal_records, ceremony_id=cid)
+    reg.inc("dkg_wal_replayed_rounds_total", result.replayed_rounds, ceremony_id=cid)

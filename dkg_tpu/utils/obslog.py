@@ -16,9 +16,17 @@ serialization.  ``tests/test_obslog.py`` greps the emitted bytes of a
 live ceremony for the committee's secrets to prove it.
 
 Channel and fault code run deep inside transport internals where no
-recorder handle exists; they emit through a thread-local *ambient*
-recorder (:func:`use` / :func:`emit_current`) that ``run_party`` binds
-for the duration of its party thread.
+recorder handle exists; they emit through an *ambient* recorder
+(:func:`use` / :func:`emit_current`) that ``run_party`` binds for the
+duration of its party thread.  The binding is a
+:class:`contextvars.ContextVar`, not a ``threading.local``: threaded
+callers see identical behavior (each thread starts from the unbound
+default), but an async scheduler multiplexing many ceremonies on ONE
+event loop (dkg_tpu.service) gets per-task isolation for free —
+``asyncio`` snapshots the context per task, so two interleaved
+ceremonies on the same thread cannot cross-contaminate each other's
+streams (tests/test_obslog.py interleaves two recorders on one thread
+to pin this).
 
 :func:`to_chrome_trace` merges any number of per-party logs into one
 Chrome/Perfetto trace-event JSON: one process per ceremony, one thread
@@ -29,6 +37,7 @@ per party, ``phase_span`` spans as complete ("X") slices with
 
 from __future__ import annotations
 
+import contextvars
 import json
 import os
 import threading
@@ -38,7 +47,13 @@ from typing import Any, Iterable
 
 from . import envknobs
 
-_TLS = threading.local()
+# The ambient recorder binding.  A ContextVar instead of threading.local:
+# identical semantics for plain threads (every thread starts unbound),
+# but copyable per asyncio task / contextvars.Context, so one scheduler
+# thread interleaving several ceremonies keeps their streams separate.
+_AMBIENT: contextvars.ContextVar["ObsLog | None"] = contextvars.ContextVar(
+    "dkg_tpu_obslog", default=None
+)
 
 
 def _sanitize(value: Any) -> Any:
@@ -146,24 +161,26 @@ class ObsLog:
         self.close()
 
 
-# -- ambient (thread-local) recorder ----------------------------------------
+# -- ambient (context-local) recorder ----------------------------------------
 
 
 class _Use:
-    """Context manager binding ``log`` as the calling thread's ambient
-    recorder; ``use(None)`` is a no-op binding (events are dropped)."""
+    """Context manager binding ``log`` as the current context's ambient
+    recorder; ``use(None)`` is a no-op binding (events are dropped).
+    Bindings nest: exit restores whatever was bound on entry."""
 
     def __init__(self, log: ObsLog | None) -> None:
         self._log = log
-        self._prev: ObsLog | None = None
+        self._token: contextvars.Token | None = None
 
     def __enter__(self) -> ObsLog | None:
-        self._prev = getattr(_TLS, "log", None)
-        _TLS.log = self._log
+        self._token = _AMBIENT.set(self._log)
         return self._log
 
     def __exit__(self, *exc) -> None:
-        _TLS.log = self._prev
+        if self._token is not None:
+            _AMBIENT.reset(self._token)
+            self._token = None
 
 
 def use(log: ObsLog | None) -> _Use:
@@ -171,8 +188,8 @@ def use(log: ObsLog | None) -> _Use:
 
 
 def current() -> ObsLog | None:
-    """The calling thread's ambient recorder, or None."""
-    return getattr(_TLS, "log", None)
+    """The current context's ambient recorder, or None."""
+    return _AMBIENT.get()
 
 
 def emit_current(kind: str, *, round: int | None = None, **fields) -> dict | None:

@@ -52,6 +52,15 @@ linter), so the committed baseline stays clean between CI runs:
         DKG005 already polices) — library telemetry goes through
         ``utils.obslog`` / ``utils.metrics`` so events are structured,
         redacted, and capturable (docs/observability.md)
+* DKG007  (dkg_tpu/service/ only) configuration or concurrency taken
+        outside the sanctioned owners: a raw ``os.environ`` read or
+        ``os.getenv()`` call — every service knob goes through
+        ``utils.envknobs`` so a typo'd value fails loudly with the
+        knob's name and meaning — or a bare thread/process spawn
+        (``threading.Thread``, ``ThreadPoolExecutor``, ``Process``, …)
+        outside ``scheduler.py``: the scheduler's worker pool is the ONE
+        place service code may create execution contexts, so
+        concurrency has a single auditable owner (docs/service.md)
 
 Exit 0 = clean.  Run: ``python scripts/lint_lite.py`` (from repo root).
 Also executed by tests/test_import_hygiene.py so the default test tier
@@ -128,6 +137,19 @@ _DIGEST_HOST_LEGS = {"_dealer_row_digests"}
 # already polices it more strictly (WAL-only).
 _DKG006_WRITER_ALLOWLIST = {"obslog.py", "precompute.py"}
 
+# Execution-context constructors banned in dkg_tpu/service/ outside the
+# scheduler (DKG007): the worker pool in scheduler.py is the single
+# sanctioned owner of service concurrency.
+_SERVICE_SPAWNERS = {
+    "Thread",
+    "ThreadPoolExecutor",
+    "ProcessPoolExecutor",
+    "Process",
+    "start_new_thread",
+    "run_in_executor",
+}
+_SERVICE_SPAWN_OWNER = "scheduler.py"
+
 
 class _Checker(ast.NodeVisitor):
     def __init__(self, path: pathlib.Path, tree: ast.Module, source: str):
@@ -142,6 +164,7 @@ class _Checker(ast.NodeVisitor):
         self._net_module = "dkg_tpu/net/" in path.as_posix()
         self._dkg_module = "dkg_tpu/dkg/" in path.as_posix()
         self._pkg_module = "dkg_tpu/" in path.as_posix()
+        self._service_module = "dkg_tpu/service/" in path.as_posix()
         self._dem_hot_module = (
             self._dkg_module and path.name in _DEM_HOT_MODULES
         )
@@ -175,7 +198,21 @@ class _Checker(ast.NodeVisitor):
         self.generic_visit(node)
 
     def visit_Attribute(self, node: ast.Attribute) -> None:
-        # track the root name of dotted access (``pkg.mod.attr`` uses pkg)
+        # DKG007a: raw environment access in service code — every knob
+        # must go through utils.envknobs (validated, named, documented).
+        if (
+            self._service_module
+            and node.attr == "environ"
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "os"
+        ):
+            self._add(
+                node,
+                "DKG007",
+                "os.environ in dkg_tpu/service/ — read knobs through "
+                "utils.envknobs so bad values fail loudly and every knob "
+                "is documented",
+            )
         self.generic_visit(node)
 
     # -- imports -------------------------------------------------------
@@ -407,6 +444,34 @@ class _Checker(ast.NodeVisitor):
                         "goes through utils.obslog (sanctioned writers: "
                         "utils/obslog.py, groups/precompute.py)",
                     )
+        # DKG007b: config/concurrency ownership in service code —
+        # os.getenv bypasses envknobs' validation, and any execution
+        # context created outside scheduler.py's worker pool splits the
+        # concurrency story across files.
+        if self._service_module:
+            func = node.func
+            name = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else ""
+            )
+            if name == "getenv":
+                self._add(
+                    node,
+                    "DKG007",
+                    "os.getenv() in dkg_tpu/service/ — read knobs through "
+                    "utils.envknobs so bad values fail loudly and every "
+                    "knob is documented",
+                )
+            if (
+                name in _SERVICE_SPAWNERS
+                and self.path.name != _SERVICE_SPAWN_OWNER
+            ):
+                self._add(
+                    node,
+                    "DKG007",
+                    f"{name}() in dkg_tpu/service/ — the scheduler's "
+                    "worker pool (service/scheduler.py) is the only "
+                    "sanctioned thread/process spawn site",
+                )
         # DKG004b: a hashlib.blake2b call lexically inside a loop in a
         # batch hot module is a per-dealer host hash loop — use
         # crypto.blake2.blake2b_batch (one array op for all n lanes).

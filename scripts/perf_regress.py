@@ -30,6 +30,15 @@ Deliberately forgiving about everything except a real regression:
   bench.py since the observability PR) is tolerated and passed through
   with an informational note — it is telemetry, never a gate.
 
+The multi-tenant service has its own history, ``FLEET_r{NN}.json``
+(scripts/fleet_bench.py): the newest two fleet rounds are diffed the
+same way — FAIL when ``ceremonies_per_s`` dropped more than the
+threshold, or when the tail latency ``p99_s`` ROSE more than the
+threshold (a throughput win bought by starving the queue tail is a
+regression for a service).  The same forgiveness rules apply: fewer
+than two comparable fleet rounds, mismatched platforms, or mismatched
+service shapes (concurrency/batch_max) skip with a note.
+
 Run: ``python scripts/perf_regress.py [--threshold 0.2] [dir]``.
 """
 
@@ -42,6 +51,7 @@ import re
 import sys
 
 _PAT = re.compile(r"BENCH_r(\d+)\.json$")
+_FLEET_PAT = re.compile(r"FLEET_r(\d+)\.json$")
 
 
 def _load_rounds(root: pathlib.Path) -> list[tuple[int, dict]]:
@@ -83,10 +93,12 @@ def main(argv: list[str] | None = None) -> int:
         else pathlib.Path(__file__).resolve().parent.parent
     )
 
+    fleet_bad = fleet_gate(root, args.threshold)
+
     rounds = _load_rounds(root)
     if len(rounds) < 2:
         print(f"perf_regress: {len(rounds)} usable round(s) in {root} — nothing to diff")
-        return 0
+        return fleet_bad
     (old_n, old), (new_n, new) = rounds[-2], rounds[-1]
     old_plat = (old.get("config") or {}).get("platform")
     new_plat = (new.get("config") or {}).get("platform")
@@ -95,7 +107,7 @@ def main(argv: list[str] | None = None) -> int:
             f"perf_regress: r{old_n} ({old_plat}) vs r{new_n} ({new_plat}) "
             "ran on different platforms — incomparable, skipping"
         )
-        return 0
+        return fleet_bad
     old_ckpt = bool((old.get("config") or {}).get("checkpoint"))
     new_ckpt = bool((new.get("config") or {}).get("checkpoint"))
     if old_ckpt != new_ckpt:
@@ -104,7 +116,7 @@ def main(argv: list[str] | None = None) -> int:
             f"(checkpoint={new_ckpt}) measured different durability modes "
             "— incomparable, skipping"
         )
-        return 0
+        return fleet_bad
     # every gated metric goes through one loop with one forgiveness
     # rule: rounds predating a metric (or with that leg failed/zero)
     # skip that gate with a note rather than blocking.
@@ -164,6 +176,81 @@ def main(argv: list[str] | None = None) -> int:
             f"perf_regress: r{new_n} carries a metrics snapshot "
             f"({n_series} series) — passed through, not gated"
         )
+    return bad or fleet_bad
+
+
+def _load_fleet_rounds(root: pathlib.Path) -> list[tuple[int, dict]]:
+    """(round number, fleet report) for every usable fleet round,
+    ascending — usable means the service leg completed and reports a
+    positive throughput."""
+    out: list[tuple[int, dict]] = []
+    for path in sorted(root.glob("FLEET_r*.json")):
+        m = _FLEET_PAT.search(path.name)
+        if not m:
+            continue
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError):
+            continue
+        service = (doc.get("service") or {}) if isinstance(doc, dict) else {}
+        rate = service.get("ceremonies_per_s")
+        if not isinstance(rate, (int, float)) or rate <= 0:
+            continue
+        out.append((int(m.group(1)), doc))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+def fleet_gate(root: pathlib.Path, threshold: float) -> int:
+    """Diff the newest two fleet rounds: throughput must not DROP and
+    tail latency must not RISE beyond the threshold."""
+    rounds = _load_fleet_rounds(root)
+    if len(rounds) < 2:
+        print(
+            f"perf_regress: {len(rounds)} usable fleet round(s) in {root} "
+            "— nothing to diff"
+        )
+        return 0
+    (old_n, old), (new_n, new) = rounds[-2], rounds[-1]
+    for key in ("platform", "concurrency", "batch_max"):
+        old_v, new_v = old.get(key), new.get(key)
+        if old_v != new_v:
+            print(
+                f"perf_regress: fleet r{old_n} ({key}={old_v}) vs "
+                f"r{new_n} ({key}={new_v}) measured different service "
+                "shapes — incomparable, skipping"
+            )
+            return 0
+    bad = 0
+    old_s, new_s = old.get("service", {}), new.get("service", {})
+    # throughput gates on DROPS, latency on RISES — sign-flipped checks
+    for label, unit, worse_sign in (
+        ("ceremonies_per_s", "ceremonies/s", -1),
+        ("p99_s", "s", +1),
+    ):
+        old_v, new_v = old_s.get(label), new_s.get(label)
+        if not (
+            isinstance(old_v, (int, float)) and old_v > 0
+            and isinstance(new_v, (int, float)) and new_v > 0
+        ):
+            print(
+                f"perf_regress: fleet {label} absent in r{old_n} or "
+                f"r{new_n} — skipping this gate"
+            )
+            continue
+        change = (new_v - old_v) / old_v
+        line = (
+            f"perf_regress: fleet {label} r{old_n} {old_v:.3f} -> "
+            f"r{new_n} {new_v:.3f} {unit} ({change:+.1%})"
+        )
+        if worse_sign * change > threshold:
+            print(
+                f"{line} — REGRESSION beyond {threshold:.0%}",
+                file=sys.stderr,
+            )
+            bad = 1
+        else:
+            print(line)
     return bad
 
 

@@ -17,7 +17,7 @@ def test_package_import_initialises_no_backend():
     code = (
         "import dkg_tpu, dkg_tpu.fields, dkg_tpu.groups, dkg_tpu.crypto, "
         "dkg_tpu.dkg, dkg_tpu.poly, dkg_tpu.ops, dkg_tpu.parallel, "
-        "dkg_tpu.net, dkg_tpu.utils\n"
+        "dkg_tpu.net, dkg_tpu.utils, dkg_tpu.service\n"
         "import jax._src.xla_bridge as xb\n"
         "assert not xb._backends, f'backends initialised at import: {list(xb._backends)}'\n"
         "print('clean')\n"
@@ -95,6 +95,51 @@ def test_lint_dkg005_bans_raw_writes_in_net():
         ).finish()
     ]
     assert "DKG005" not in codes, codes
+
+
+def test_lint_dkg007_bans_raw_config_and_spawns_in_service():
+    """DKG007: service code reads knobs only through utils.envknobs
+    (no raw ``os.environ`` / ``os.getenv``) and spawns execution
+    contexts only in scheduler.py (the worker pool's single owner).
+    The rule is scoped to dkg_tpu/service/ — the same source elsewhere
+    is clean."""
+    import ast
+    import pathlib
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "scripts"))
+    try:
+        import lint_lite
+    finally:
+        sys.path.pop(0)
+
+    src = (
+        "import os, threading\n"
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "def f():\n"
+        "    a = os.environ['DKG_TPU_SERVICE_CONCURRENCY']\n"
+        "    b = os.getenv('DKG_TPU_SERVICE_QUEUE_DEPTH')\n"
+        "    threading.Thread(target=f).start()\n"
+        "    ThreadPoolExecutor(2)\n"
+        "    return a, b\n"
+    )
+    tree = ast.parse(src)
+
+    def codes_for(path: str) -> list:
+        return [
+            c
+            for _, c, _ in lint_lite._Checker(
+                pathlib.Path(path), tree, src
+            ).finish()
+            if c == "DKG007"
+        ]
+
+    # environ + getenv + Thread + ThreadPoolExecutor = 4 findings
+    assert len(codes_for("dkg_tpu/service/engine.py")) == 4
+    # scheduler.py owns the worker pool: spawns allowed, raw config not
+    assert len(codes_for("dkg_tpu/service/scheduler.py")) == 2
+    # the rule is service-scoped
+    assert codes_for("dkg_tpu/net/elsewhere.py") == []
+    assert codes_for("scripts/tool.py") == []
 
 
 def test_hostmesh_import_is_lightweight():

@@ -133,6 +133,80 @@ def test_observe_party_result_maps_every_counter():
     assert snap["dkg_wal_replayed_rounds_total"] == 2
 
 
+def test_label_values_are_escaped_in_exposition():
+    """A hostile or merely unlucky label value (quotes, backslashes,
+    newlines — e.g. an error string used as a label) must not be able
+    to break the Prometheus exposition format."""
+    reg = MetricsRegistry()
+    nasty = 'he said "hi"\\\nand left'
+    reg.inc("dkg_errors_total", kind=nasty)
+    text = reg.prometheus_text()
+    lines = text.splitlines()
+    # the exposition stays line-oriented: no raw newline leaked through
+    assert all("\n" not in l for l in lines)
+    [series] = [l for l in lines if l.startswith("dkg_errors_total{")]
+    assert series == (
+        'dkg_errors_total{kind="he said \\"hi\\"\\\\\\nand left"} 1'
+    )
+    # snapshot keys carry the same escaped series name, so exposition
+    # lines and snapshot entries always name the same series
+    assert reg.snapshot()["counters"][series.rsplit(" ", 1)[0]] == 1
+
+
+def test_none_valued_labels_are_dropped():
+    reg = MetricsRegistry()
+    reg.inc("dkg_x_total", ceremony_id=None)
+    reg.observe("dkg_y_seconds", 0.1, ceremony_id=None, phase="deal")
+    snap = reg.snapshot()
+    assert snap["counters"] == {"dkg_x_total": 1}
+    assert list(snap["histograms"]) == ['dkg_y_seconds{phase="deal"}']
+
+
+def test_observe_trace_labels_series_with_ceremony_id():
+    reg = MetricsRegistry()
+    tr = CeremonyTrace()
+    tr.record("deal", 1.0)
+    tr.bump("complaints_filed", 1)
+    observe_trace(tr, registry=reg, ceremony_id="abc123")
+    snap = reg.snapshot()
+    assert snap["counters"]['dkg_ceremonies_total{ceremony_id="abc123"}'] == 1
+    assert (
+        snap["counters"][
+            'dkg_ceremony_counter_total{ceremony_id="abc123",counter="complaints_filed"}'
+        ]
+        == 1
+    )
+    assert (
+        snap["histograms"][
+            'dkg_phase_seconds{ceremony_id="abc123",phase="deal"}'
+        ]["count"]
+        == 1
+    )
+    # two tenants feeding one registry stay distinct series
+    tr2 = CeremonyTrace()
+    tr2.record("deal", 2.0)
+    observe_trace(tr2, registry=reg, ceremony_id="def456")
+    snap = reg.snapshot()
+    assert snap["counters"]['dkg_ceremonies_total{ceremony_id="abc123"}'] == 1
+    assert snap["counters"]['dkg_ceremonies_total{ceremony_id="def456"}'] == 1
+
+
+def test_observe_party_result_labels_series_with_ceremony_id():
+    from dkg_tpu.net.party import PartyResult
+
+    reg = MetricsRegistry()
+    res = PartyResult(index=1)
+    res.quarantined = 1
+    observe_party_result(res, registry=reg, ceremony_id="c1")
+    snap = reg.snapshot()["counters"]
+    assert snap['dkg_parties_total{ceremony_id="c1",outcome="error"}'] == 1
+    assert snap['dkg_party_quarantined_total{ceremony_id="c1"}'] == 1
+    # prometheus text for the labelled registry still parses line-wise
+    reg2 = MetricsRegistry()
+    observe_party_result(res, registry=reg2)  # no id -> legacy series
+    assert "dkg_party_quarantined_total" in reg2.snapshot()["counters"]
+
+
 def test_registry_is_thread_safe():
     reg = MetricsRegistry()
 

@@ -106,6 +106,56 @@ def test_ambient_recorder_is_thread_local():
     assert [e["kind"] for e in log.events()] == ["seen"]
 
 
+def test_ambient_recorder_interleaves_on_one_thread():
+    """The contextvars regression: two recorders bound in two
+    contexts INTERLEAVE on a single thread without cross-contaminating
+    each other's streams — the property a thread-local binding cannot
+    provide, and the one an async scheduler multiplexing ceremonies on
+    one event loop depends on."""
+    import contextvars
+
+    log_a, log_b = obslog.ObsLog(), obslog.ObsLog()
+    ctx_a, ctx_b = contextvars.copy_context(), contextvars.copy_context()
+    # bind each recorder inside its own context (the binding persists
+    # in that Context object across run() calls)
+    ctx_a.run(obslog.use(log_a).__enter__)
+    ctx_b.run(obslog.use(log_b).__enter__)
+    # interleave emissions A/B/A/B ... on THIS thread
+    for i in range(3):
+        ctx_a.run(obslog.emit_current, "a", i=i)
+        ctx_b.run(obslog.emit_current, "b", i=i)
+    assert [e["kind"] for e in log_a.events()] == ["a"] * 3
+    assert [e["kind"] for e in log_b.events()] == ["b"] * 3
+    assert [e["i"] for e in log_a.events()] == [0, 1, 2]
+    # the outer (unbound) context never saw either recorder
+    assert obslog.current() is None
+
+
+def test_ambient_recorder_isolates_asyncio_tasks():
+    """asyncio snapshots the context per task, so two ceremonies
+    interleaving awaits on ONE event-loop thread keep their ambient
+    recorders separate."""
+    import asyncio
+
+    async def party(log, kind, events):
+        with obslog.use(log):
+            obslog.emit_current(kind, step=0)
+            await events  # yield to the other task mid-ceremony
+            assert obslog.current() is log
+            obslog.emit_current(kind, step=1)
+
+    async def main():
+        a, b = obslog.ObsLog(), obslog.ObsLog()
+        await asyncio.gather(
+            party(a, "a", asyncio.sleep(0)), party(b, "b", asyncio.sleep(0))
+        )
+        return a, b
+
+    log_a, log_b = asyncio.run(main())
+    assert [e["kind"] for e in log_a.events()] == ["a", "a"]
+    assert [e["kind"] for e in log_b.events()] == ["b", "b"]
+
+
 def test_ceremony_id_is_deterministic_per_environment():
     from dkg_tpu.net.faults import make_committee
 

@@ -89,6 +89,43 @@ def test_base_table_matches_device_builder(table_cache):
     np.testing.assert_array_equal(via_cache, direct)
 
 
+def test_concurrent_warmers_build_exactly_once(table_cache):
+    """N threads racing to warm the SAME table (the multi-tenant
+    service's workers all ask for g/h at startup) serialize into exactly
+    one build; everyone gets the same array object."""
+    import threading
+
+    n_threads = 8
+    barrier = threading.Barrier(n_threads)
+    results: list = [None] * n_threads
+    errors: list = []
+
+    def warm(i):
+        try:
+            barrier.wait(timeout=10)
+            results[i] = gp.host_table(CS, _gen_key(), window=4)
+        except Exception as exc:  # noqa: BLE001 — surfaced below
+            errors.append(exc)
+
+    threads = [threading.Thread(target=warm, args=(i,)) for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    st = gp.stats()
+    assert st["builds"] == 1, f"racing warmers built {st['builds']} tables"
+    assert st["disk_loads"] == 0
+    assert st["proc_hits"] == n_threads - 1
+    first = results[0]
+    assert first is not None
+    assert all(r is first for r in results), "all threads must share one table"
+    # and the winning build produced a valid, persisted table
+    np.testing.assert_array_equal(
+        np.asarray(first), gd._fixed_table_np.__wrapped__(CS, _gen_key(), 4)
+    )
+
+
 def test_ceremony_master_key_identical_cached_vs_fresh(table_cache):
     from dkg_tpu.dkg import ceremony as ce
 

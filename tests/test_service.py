@@ -1,0 +1,582 @@
+"""Multi-tenant ceremony service (dkg_tpu.service).
+
+Three layers, cheapest first:
+
+* pure-policy tests — bucketing ladder, convoy splitting, request ids,
+  journal replay/compaction, scheduler admission/deadline/backpressure
+  semantics with the ENGINE MONKEYPATCHED OUT (no JAX work at all, so
+  the scheduler's concurrency story is exercised hundreds of times per
+  second);
+* real-engine tests at the smallest bucket (ristretto255 (5,2) ->
+  bucket (8,2), width-1 convoys so the plain executables are shared
+  with the rest of the suite's in-process jit cache) — the
+  padded-vs-unpadded oracle, scheduler end-to-end masters vs fresh
+  references, and WAL-backed crash recovery;
+* ``slow``-marked legs — the stacked (vmapped) convoy lane's bit-
+  exactness, the convoy-batched Fiat-Shamir fold, and the secp256k1
+  wire-byte oracle (padded KEM/DEM bytes == unpadded pipeline bytes).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dkg_tpu.service import buckets, engine
+from dkg_tpu.service import scheduler as scheduler_mod
+from dkg_tpu.service.durable import ServiceJournal
+from dkg_tpu.service.engine import CeremonyOutcome, CeremonyRequest
+from dkg_tpu.service.scheduler import CeremonyScheduler, QueueFullError
+from dkg_tpu.utils.metrics import MetricsRegistry
+
+CURVE = "ristretto255"
+N, T = 5, 2  # buckets to (8, 2): the smallest ladder rung
+
+
+# ---------------------------------------------------------------------------
+# bucketing policy (pure python)
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_for_rounds_up_to_ladder():
+    assert buckets.bucket_for(5, 2) == buckets.Bucket(8, 2)
+    assert buckets.bucket_for(8, 2) == buckets.Bucket(8, 2)
+    assert buckets.bucket_for(5, 3) == buckets.Bucket(8, 3)
+    assert buckets.bucket_for(16, 5) == buckets.Bucket(16, 5)
+    assert buckets.bucket_for(9, 3) == buckets.Bucket(16, 4)
+    assert buckets.bucket_for(24, 8) == buckets.Bucket(32, 8)
+    assert buckets.bucket_for(64, 16) == buckets.Bucket(64, 16)
+    # committee sizes below the floor pad up to it
+    assert buckets.bucket_for(2, 1) == buckets.Bucket(8, 2)
+
+
+def test_bucket_for_escalates_degenerate_thresholds():
+    # t beyond n_pad's maximal rung escalates to the next n bucket
+    b = buckets.bucket_for(8, 4)  # rungs at n=8 are (2, 3)
+    assert b.n == 16 and b.t >= 4
+
+
+def test_bucket_for_rejects_unbucketable_shapes():
+    with pytest.raises(ValueError):
+        buckets.bucket_for(1, 1)
+    with pytest.raises(ValueError):
+        buckets.bucket_for(buckets.MAX_BUCKET_N + 1, 2)
+    with pytest.raises(ValueError):
+        buckets.bucket_for(5, 5)  # t >= n
+    with pytest.raises(ValueError):
+        buckets.bucket_for(5, 0)
+
+
+def test_t_rungs_ascend_and_dominate_regimes():
+    for n_pad in (8, 16, 32, 64, 4096):
+        rungs = buckets.t_rungs(n_pad)
+        assert rungs == tuple(sorted(rungs))
+        assert rungs[-1] == (n_pad - 1) // 2  # maximal honest-majority
+
+
+def test_split_widths_greedy_ladder():
+    assert buckets.split_widths(7) == [4, 2, 1]
+    assert buckets.split_widths(8) == [8]
+    assert buckets.split_widths(9) == [8, 1]
+    assert buckets.split_widths(0) == []
+    assert buckets.split_widths(7, batch_max=2) == [2, 2, 2, 1]
+    with pytest.raises(ValueError):
+        buckets.split_widths(-1)
+    # every decomposition sums back and uses only ladder widths
+    for k in range(0, 40):
+        ws = buckets.split_widths(k)
+        assert sum(ws) == k
+        assert all(w in buckets.WIDTHS for w in ws)
+
+
+def test_width_cap_stops_stacking_past_the_crossover():
+    # below the crossover the full ladder is available; at/above it the
+    # bucket runs width-1 (stacking is a measured loss there)
+    assert buckets.width_cap(buckets.Bucket(8, 2)) == buckets.WIDTHS[0]
+    assert buckets.width_cap(buckets.Bucket(16, 5)) == buckets.WIDTHS[0]
+    assert buckets.width_cap(buckets.Bucket(32, 8)) == buckets.WIDTHS[0]
+    assert buckets.width_cap(buckets.Bucket(64, 16)) == 1
+    assert buckets.width_cap(buckets.Bucket(4096, 1365)) == 1
+
+
+def test_padded_config_requires_domination():
+    from dkg_tpu.dkg import ceremony as ce
+
+    cfg = ce.CeremonyConfig(CURVE, 5, 2)
+    assert cfg.padded(8, 2).n == 8
+    with pytest.raises(ValueError):
+        cfg.padded(4, 2)
+    with pytest.raises(ValueError):
+        cfg.padded(8, 1)
+
+
+def test_request_id_binds_identity_and_sequence():
+    req = CeremonyRequest(CURVE, N, T, seed=1)
+    assert engine.request_id(req, 0) == engine.request_id(req, 0)
+    assert engine.request_id(req, 0) != engine.request_id(req, 1)
+    other = CeremonyRequest(CURVE, N, T, seed=2)
+    assert engine.request_id(req, 0) != engine.request_id(other, 0)
+
+
+def test_convoy_key_separates_incompatible_requests():
+    a = CeremonyRequest(CURVE, 5, 2, seed=1)
+    b = CeremonyRequest(CURVE, 8, 2, seed=2)  # same bucket, same key
+    assert a.convoy_key() == b.convoy_key()
+    assert a.convoy_key() != CeremonyRequest(CURVE, 5, 2, rho_bits=64).convoy_key()
+    assert (
+        a.convoy_key()
+        != CeremonyRequest(CURVE, 5, 2, shared_string=b"other").convoy_key()
+    )
+
+
+def test_start_convoy_rejects_mixed_keys():
+    with pytest.raises(ValueError):
+        engine.start_convoy(
+            engine.WarmRuntime(),
+            [
+                CeremonyRequest(CURVE, N, T, seed=1),
+                CeremonyRequest(CURVE, N, T, seed=2, rho_bits=64),
+            ],
+        )
+
+
+# ---------------------------------------------------------------------------
+# durability journal (pure python over PartyWal)
+# ---------------------------------------------------------------------------
+
+
+def test_journal_replay_partitions_pending_and_terminal(tmp_path):
+    j = ServiceJournal(tmp_path)
+    r1 = CeremonyRequest(CURVE, 5, 2, seed=11, durable=True, tag="one")
+    r2 = CeremonyRequest(CURVE, 6, 2, seed=12, durable=True, deadline_s=9.0)
+    j.record_request("cid1", 0, r1)
+    j.record_request("cid2", 1, r2)
+    j.record_done(
+        CeremonyOutcome(
+            ceremony_id="cid1", status="done", curve=CURVE, n=5, t=2,
+            bucket_n=8, bucket_t=2, master=b"\x01\x02",
+            qualified=(True,) * 5, complaints=((2, 1),),
+        )
+    )
+    pending, terminal = j.replay()
+    assert set(pending) == {"cid2"}
+    seq, req = pending["cid2"]
+    assert seq == 1
+    assert (req.curve, req.n, req.t, req.seed) == (CURVE, 6, 2, 12)
+    assert req.durable and req.deadline_s == 9.0
+    assert set(terminal) == {"cid1"}
+    out = terminal["cid1"]
+    assert out.status == "done" and out.master == b"\x01\x02"
+    assert out.qualified == (True,) * 5 and out.complaints == ((2, 1),)
+
+
+def test_journal_skips_unparseable_bodies_and_compacts(tmp_path):
+    j = ServiceJournal(tmp_path)
+    j.record_request("cid1", 0, CeremonyRequest(CURVE, 5, 2, seed=1, durable=True))
+    j.wal.append(b"not json {")  # version skew, not corruption
+    j.wal.append(json.dumps({"no": "kind"}).encode())
+    pending, terminal = j.replay()
+    assert set(pending) == {"cid1"} and not terminal
+    j.compact(pending, terminal)
+    # compacted journal replays to the identical state, junk dropped
+    pending2, terminal2 = ServiceJournal(tmp_path).replay()
+    assert set(pending2) == {"cid1"} and not terminal2
+    assert pending2["cid1"][1] == pending["cid1"][1]
+
+
+# ---------------------------------------------------------------------------
+# scheduler semantics with the engine monkeypatched out (no JAX work)
+# ---------------------------------------------------------------------------
+
+
+class _FakeEngine:
+    """Stand-in for start_convoy/finish_convoy: records convoy widths,
+    optionally gates the start call on an event so tests can hold a
+    worker mid-pipeline while they poke the queue."""
+
+    def __init__(self, gate: threading.Event | None = None):
+        self.gate = gate
+        self.widths: list[int] = []
+        self.starts = 0
+
+    def start(self, runtime, reqs, ids=None):
+        self.starts += 1
+        self.widths.append(len(reqs))
+        if self.gate is not None:
+            assert self.gate.wait(timeout=10)
+        return {"reqs": list(reqs), "ids": list(ids)}
+
+    def finish(self, runtime, fl):
+        return [
+            CeremonyOutcome(
+                ceremony_id=cid, status="done", curve=r.curve, n=r.n, t=r.t,
+                bucket_n=r.bucket().n, bucket_t=r.bucket().t,
+                master=b"M:" + cid.encode(),
+                qualified=(True,) * r.n,
+            )
+            for cid, r in zip(fl["ids"], fl["reqs"])
+        ]
+
+
+@pytest.fixture()
+def fake_engine(monkeypatch):
+    fake = _FakeEngine(gate=threading.Event())
+    monkeypatch.setattr(scheduler_mod, "start_convoy", fake.start)
+    monkeypatch.setattr(scheduler_mod, "finish_convoy", fake.finish)
+    yield fake
+    fake.gate.set()  # never leave a worker parked on the gate
+
+
+def _wait_status(sch, cid, status, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if sch.poll(cid) == status:
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"{cid} never reached {status} (at {sch.poll(cid)})")
+
+
+def test_submit_validates_before_queueing(fake_engine):
+    sch = CeremonyScheduler(concurrency=1, queue_depth=4, batch_max=1, runtime=object())
+    try:
+        with pytest.raises(ValueError):
+            sch.submit(CeremonyRequest(CURVE, 1, 1))  # unbucketable
+        with pytest.raises(ValueError):
+            sch.submit(CeremonyRequest(CURVE, 5, 2, durable=True))  # no seed
+        with pytest.raises(ValueError):  # seeded but scheduler has no WAL
+            sch.submit(CeremonyRequest(CURVE, 5, 2, seed=1, durable=True))
+        assert sch.poll("nonexistent") == "unknown"
+        with pytest.raises(KeyError):
+            sch.result("nonexistent")
+    finally:
+        fake_engine.gate.set()
+        sch.close()
+
+
+def test_backpressure_rejects_when_queue_full(fake_engine):
+    reg = MetricsRegistry()
+    sch = CeremonyScheduler(
+        concurrency=1, queue_depth=2, batch_max=1, runtime=object(), metrics=reg
+    )
+    try:
+        held = sch.submit(CeremonyRequest(CURVE, 5, 2, seed=0))
+        _wait_status(sch, held, "running")  # worker parked on the gate
+        q1 = sch.submit(CeremonyRequest(CURVE, 5, 2, seed=1))
+        sch.submit(CeremonyRequest(CURVE, 5, 2, seed=2))
+        with pytest.raises(QueueFullError):
+            sch.submit(CeremonyRequest(CURVE, 5, 2, seed=3))
+        assert sch.poll(q1) == "queued"
+        with pytest.raises(TimeoutError):
+            sch.result(q1, timeout=0.01)
+        snap = reg.snapshot()["counters"]
+        assert snap["service_rejected_total"] == 1
+        assert snap["service_submitted_total"] == 3
+    finally:
+        fake_engine.gate.set()
+        sch.close()
+    assert sch.result(held).master == b"M:" + held.encode()
+    assert sch.result(q1).status == "done"
+
+
+def test_deadline_expires_queued_ceremonies(fake_engine):
+    sch = CeremonyScheduler(concurrency=1, queue_depth=8, batch_max=1, runtime=object())
+    try:
+        held = sch.submit(CeremonyRequest(CURVE, 5, 2, seed=0))
+        _wait_status(sch, held, "running")
+        doomed = sch.submit(CeremonyRequest(CURVE, 5, 2, seed=1, deadline_s=0.05))
+        time.sleep(0.15)  # expires while the worker is parked
+    finally:
+        fake_engine.gate.set()
+    out = sch.result(doomed, timeout=5)
+    assert out.status == "expired"
+    assert out.error == "DEADLINE_EXCEEDED"
+    assert out.master == b""
+    sch.close()
+
+
+def test_convoys_batch_same_key_in_ladder_widths(fake_engine):
+    sch = CeremonyScheduler(concurrency=1, queue_depth=16, batch_max=8, runtime=object())
+    try:
+        held = sch.submit(CeremonyRequest(CURVE, 5, 2, seed=0, rho_bits=32))
+        _wait_status(sch, held, "running")
+        # three same-key requests with a different-key one interleaved:
+        # the stranger must never ride in their convoy
+        ids_a = [
+            sch.submit(CeremonyRequest(CURVE, 5, 2, seed=1 + i)) for i in range(2)
+        ]
+        id_b = sch.submit(CeremonyRequest(CURVE, 5, 2, seed=9, rho_bits=64))
+        ids_a.append(sch.submit(CeremonyRequest(CURVE, 5, 2, seed=3)))
+    finally:
+        fake_engine.gate.set()
+    outs = [sch.result(i, timeout=10) for i in ids_a + [id_b, held]]
+    assert all(o.status == "done" for o in outs)
+    sch.close()
+    # ladder truncation: 3 same-key mates pop as width 2 (next rung
+    # under 3), then the different-key head as 1, then the leftover
+    assert fake_engine.widths == [1, 2, 1, 1]
+
+
+def test_close_without_drain_fails_queued_work(fake_engine):
+    sch = CeremonyScheduler(concurrency=1, queue_depth=8, batch_max=1, runtime=object())
+    held = sch.submit(CeremonyRequest(CURVE, 5, 2, seed=0))
+    _wait_status(sch, held, "running")
+    dropped = sch.submit(CeremonyRequest(CURVE, 5, 2, seed=1))
+    fake_engine.gate.set()
+    sch.close(drain=False)
+    out = sch.result(dropped, timeout=5)
+    assert out.status == "failed" and out.error == "SHUTDOWN"
+    with pytest.raises(QueueFullError):
+        sch.submit(CeremonyRequest(CURVE, 5, 2, seed=2))
+
+
+def test_recovery_resubmits_pending_and_reserves_terminal(tmp_path, fake_engine):
+    reg = MetricsRegistry()
+    j = ServiceJournal(tmp_path)
+    j.record_request("cidA", 0, CeremonyRequest(CURVE, 5, 2, seed=21, durable=True))
+    j.record_request("cidB", 1, CeremonyRequest(CURVE, 5, 2, seed=22, durable=True))
+    j.record_done(
+        CeremonyOutcome(
+            ceremony_id="cidT", status="done", curve=CURVE, n=5, t=2,
+            bucket_n=8, bucket_t=2, master=b"\xaa\xbb",
+        )
+    )
+    fake_engine.gate.set()  # recovery runs straight through
+    sch = CeremonyScheduler(
+        concurrency=1, queue_depth=8, batch_max=8,
+        wal_dir=str(tmp_path), runtime=object(), metrics=reg,
+    )
+    # terminal outcome re-served from the journal, never re-run
+    assert sch.poll("cidT") == "done"
+    assert sch.result("cidT").master == b"\xaa\xbb"
+    # pending ceremonies resubmitted under their ORIGINAL ids and run
+    for cid in ("cidA", "cidB"):
+        out = sch.result(cid, timeout=10)
+        assert out.status == "done" and out.master == b"M:" + cid.encode()
+    assert reg.snapshot()["counters"]["service_recovered_total"] == 2
+    sch.close()
+    starts_after_first = fake_engine.starts
+    assert starts_after_first >= 1
+
+    # second restart: everything is terminal now — nothing re-runs
+    sch2 = CeremonyScheduler(
+        concurrency=1, queue_depth=8, batch_max=8,
+        wal_dir=str(tmp_path), runtime=object(),
+    )
+    for cid, master in (("cidA", b"M:cidA"), ("cidB", b"M:cidB"), ("cidT", b"\xaa\xbb")):
+        assert sch2.poll(cid) == sch2.result(cid).status == "done"
+        assert sch2.result(cid).master == master
+    sch2.close()
+    assert fake_engine.starts == starts_after_first
+
+
+def test_scheduler_reads_envknobs(monkeypatch, fake_engine):
+    monkeypatch.delenv("DKG_TPU_SERVICE_WAL_DIR", raising=False)
+    monkeypatch.setenv("DKG_TPU_SERVICE_CONCURRENCY", "2")
+    monkeypatch.setenv("DKG_TPU_SERVICE_QUEUE_DEPTH", "5")
+    monkeypatch.setenv("DKG_TPU_SERVICE_BATCH_MAX", "4")
+    monkeypatch.setenv("DKG_TPU_SERVICE_DEADLINE_S", "30.5")
+    sch = CeremonyScheduler(runtime=object())
+    try:
+        assert sch.concurrency == 2
+        assert sch.queue_depth == 5
+        assert sch.batch_max == 4
+        assert sch.default_deadline_s == 30.5
+        assert len(sch._workers) == 2
+    finally:
+        fake_engine.gate.set()
+        sch.close()
+    monkeypatch.setenv("DKG_TPU_SERVICE_QUEUE_DEPTH", "zero")
+    with pytest.raises(ValueError):
+        CeremonyScheduler(runtime=object())
+
+
+# ---------------------------------------------------------------------------
+# real engine, smallest bucket: pad-and-mask oracle + end-to-end masters
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def runtime():
+    return engine.WarmRuntime()
+
+
+@pytest.fixture(scope="module")
+def convoy1(runtime):
+    """One seeded width-1 ceremony through the padded lane, plus its
+    in-flight tensors (kept for the tensor-level oracle)."""
+    req = CeremonyRequest(CURVE, N, T, seed=0xC0FFEE, rho_bits=32)
+    fl = engine.start_convoy(runtime, [req])
+    outs = engine.finish_convoy(runtime, fl)
+    return req, fl, outs
+
+
+def test_padded_run_matches_unpadded_real_lanes(runtime, convoy1):
+    """The pad-and-mask contract at tensor level: every real lane of the
+    padded round-1 tensors is bit-identical to the unpadded run, and the
+    phantom dealers deal all-zero shares."""
+    import jax.numpy as jnp
+
+    from dkg_tpu.dkg import ceremony as ce
+
+    req, fl, _ = convoy1
+    cfg = ce.CeremonyConfig(req.curve, req.n, req.t)
+    _, g_table, h_table = runtime.commitment(req.curve, req.shared_string)
+    ca, cb = engine.draw_coeffs(cfg, engine.rng_for(req))
+    a, e, s, r = ce.deal(cfg, jnp.asarray(ca), jnp.asarray(cb), g_table, h_table)
+    n, tc = req.n, req.t + 1
+    np.testing.assert_array_equal(np.asarray(fl.a[0])[:n, :tc], np.asarray(a))
+    np.testing.assert_array_equal(np.asarray(fl.e[0])[:n, :tc], np.asarray(e))
+    np.testing.assert_array_equal(np.asarray(fl.s[0])[:n, :n], np.asarray(s))
+    np.testing.assert_array_equal(np.asarray(fl.r[0])[:n, :n], np.asarray(r))
+    # phantom dealers are zero polynomials: zero shares to everyone
+    assert not np.asarray(fl.s[0])[n:].any()
+    assert not np.asarray(fl.r[0])[n:].any()
+
+
+def test_padded_master_matches_fresh_single_run(convoy1):
+    """The service's padded+bucketed execution must be invisible in the
+    result: same seed, same master key as a fresh unpadded ceremony."""
+    req, _, outs = convoy1
+    (out,) = outs
+    assert out.status == "done"
+    assert out.qualified == (True,) * req.n
+    assert out.complaints == ()
+    assert out.bucket_n == 8 and out.bucket_t == 2
+    assert out.final_shares is not None and len(out.final_shares) == req.n
+    assert out.master == engine.run_single_reference(req)
+
+
+def test_scheduler_end_to_end_masters_match_references(runtime):
+    reqs = [CeremonyRequest(CURVE, N, T, seed=500 + i, rho_bits=32) for i in range(3)]
+    with CeremonyScheduler(
+        concurrency=2, queue_depth=8, batch_max=1, runtime=runtime
+    ) as sch:
+        ids = [sch.submit(r) for r in reqs]
+        outs = [sch.result(i, timeout=120) for i in ids]
+    for req, out in zip(reqs, outs):
+        assert out.status == "done"
+        assert out.master == engine.run_single_reference(req)
+        assert out.completed_at > 0 and out.seconds > 0
+
+
+def test_durable_restart_resumes_and_reserves(tmp_path, runtime, monkeypatch):
+    """Kill-and-restart: requests journalled at admission but never
+    finished (the crash window) are re-run from their seeds on restart
+    with zero failures and bit-identical masters; a second restart
+    re-serves the outcomes without touching the engine."""
+    reqs = [
+        CeremonyRequest(CURVE, N, T, seed=900 + i, rho_bits=32, durable=True)
+        for i in range(2)
+    ]
+    crashed = ServiceJournal(tmp_path)
+    cids = [engine.request_id(r, i) for i, r in enumerate(reqs)]
+    for i, (cid, r) in enumerate(zip(cids, reqs)):
+        crashed.record_request(cid, i, r)
+
+    sch = CeremonyScheduler(
+        concurrency=1, queue_depth=8, batch_max=1,
+        wal_dir=str(tmp_path), runtime=runtime,
+    )
+    outs = [sch.result(cid, timeout=300) for cid in cids]
+    sch.close()
+    assert [o.status for o in outs] == ["done", "done"]
+    masters = [engine.run_single_reference(r) for r in reqs]
+    assert [o.master for o in outs] == masters
+
+    def _bomb(*a, **kw):
+        raise AssertionError("restart with a fully terminal journal re-ran work")
+
+    monkeypatch.setattr(scheduler_mod, "start_convoy", _bomb)
+    sch2 = CeremonyScheduler(
+        concurrency=1, queue_depth=8, batch_max=1,
+        wal_dir=str(tmp_path), runtime=runtime,
+    )
+    for cid, master in zip(cids, masters):
+        assert sch2.poll(cid) == "done"
+        out = sch2.result(cid)
+        assert out.master == master
+        assert out.final_shares is None  # secrets never touch the journal
+    sch2.close()
+
+
+# ---------------------------------------------------------------------------
+# slow legs: stacked convoys, convoy-folded Fiat-Shamir, secp wire bytes
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_stacked_convoy_bit_exact_and_rho_fold(runtime):
+    """A width-2 stacked convoy (vmapped lane) returns bit-identical
+    masters to fresh single runs, and the convoy-folded Fiat-Shamir
+    derivation equals the per-ceremony one on every lane."""
+    from dkg_tpu.dkg import ceremony as ce
+
+    reqs = [CeremonyRequest(CURVE, N, T, seed=700 + i, rho_bits=32) for i in range(2)]
+    fl = engine.start_convoy(runtime, reqs)
+    a, e = np.asarray(fl.a), np.asarray(fl.e)
+    s, r = np.asarray(fl.s), np.asarray(fl.r)
+    rho_convoy = engine.derive_rho_convoy(fl.cfg_pad, a, e, s, r, 32)
+    for i in range(2):
+        rho_one = ce.derive_rho(fl.cfg_pad, a[i], e[i], s[i], r[i], 32)
+        np.testing.assert_array_equal(rho_convoy[i], np.asarray(rho_one))
+    outs = engine.finish_convoy(runtime, fl)
+    for req, out in zip(reqs, outs):
+        assert out.status == "done"
+        assert out.master == engine.run_single_reference(req)
+
+
+@pytest.mark.slow
+def test_secp_padded_wire_bytes_match_unpadded_pipeline(runtime):
+    """secp256k1 leg with BOTH axes padded ((5,1) -> bucket (8,2)): the
+    padded lane's wire-format BroadcastPhase1 bytes are identical to the
+    unpadded ``seal_shares_pipeline`` leg, and the master matches a
+    fresh unpadded run."""
+    import jax.numpy as jnp
+
+    from dkg_tpu.dkg import ceremony as ce
+    from dkg_tpu.dkg.hybrid_batch import broadcasts_from_batch, seal_shares_pipeline
+    from dkg_tpu.fields import host as fh
+    from dkg_tpu.groups import device as gd
+    from dkg_tpu.groups import host as gh
+    from dkg_tpu.utils import serde
+
+    curve, n, t = "secp256k1", 5, 1
+    req = CeremonyRequest(curve, n, t, seed=31337, rho_bits=32)
+    assert req.bucket() == buckets.Bucket(8, 2)  # n AND t both pad
+    group = gh.ALL_GROUPS[curve]
+    pks = [group.scalar_mul(i + 7, group.generator()) for i in range(n)]
+
+    fl = engine.start_convoy(runtime, [req])
+    wire_padded = engine.wire_broadcasts(
+        runtime, req, fl, 0, pks, random.Random(99)
+    )
+
+    # unpadded reference: same coeffs, real-shape deal + seal pipeline
+    cfg = ce.CeremonyConfig(curve, n, t)
+    _, g_table, h_table = runtime.commitment(curve, req.shared_string)
+    ca, cb = engine.draw_coeffs(cfg, engine.rng_for(req))
+    _, e_r, s_r, r_r = ce.deal(cfg, jnp.asarray(ca), jnp.asarray(cb), g_table, h_table)
+    fs = cfg.cs.scalar
+    rng = random.Random(99)
+    r_enc = fh.encode(
+        fs, [[fs.rand_int(rng) for _ in range(n)] for _ in range(n)]
+    )
+    sealed = seal_shares_pipeline(
+        group, cfg, np.asarray(s_r), np.asarray(r_r),
+        gd.from_host(cfg.cs, pks), jnp.asarray(r_enc), g_table,
+    )
+    bcasts = broadcasts_from_batch(group, cfg, np.asarray(e_r), sealed)
+    wire_ref = [serde.encode_phase1(group, b) for b in bcasts]
+
+    assert len(wire_padded) == len(wire_ref) == n
+    for got, want in zip(wire_padded, wire_ref):
+        assert got == want
+
+    (out,) = engine.finish_convoy(runtime, fl)
+    assert out.status == "done"
+    assert out.master == engine.run_single_reference(req)
