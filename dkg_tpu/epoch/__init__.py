@@ -1,0 +1,43 @@
+"""Epoch subsystem: proactive share refresh + committee resharing.
+
+The ceremony (dkg_tpu.dkg, driven by dkg_tpu.net.party) produces epoch
+0: an (n, t) sharing of the master secret.  This package evolves that
+sharing WITHOUT ever changing the master public key:
+
+* :class:`EpochManager` — the networked protocol: 3 broadcast rounds
+  per operation over the same channel/WAL as the ceremony, crash
+  resumable, churn- and deadline-bounded (see epoch.manager).
+* :mod:`~dkg_tpu.epoch.inprocess` — the service lane: same algebra as
+  one batched device computation over a locally-held share vector.
+
+See docs/resharing.md for the protocol and its invariance argument.
+"""
+
+from .errors import EpochError
+from .manager import EPOCH_ROUND_BASE, ROUNDS_PER_OP, EpochManager, epoch_rounds
+from .state import (
+    KIND_NAMES,
+    KIND_REFRESH,
+    KIND_RESHARE,
+    EpochState,
+    confirm_digest,
+    decode_epoch_state,
+    encode_epoch_state,
+    genesis_from_party_result,
+)
+
+__all__ = [
+    "EPOCH_ROUND_BASE",
+    "ROUNDS_PER_OP",
+    "EpochError",
+    "EpochManager",
+    "EpochState",
+    "KIND_NAMES",
+    "KIND_REFRESH",
+    "KIND_RESHARE",
+    "confirm_digest",
+    "decode_epoch_state",
+    "encode_epoch_state",
+    "epoch_rounds",
+    "genesis_from_party_result",
+]

@@ -1,0 +1,23 @@
+"""Typed failures of the epoch subsystem (refresh / resharing).
+
+Separate from :class:`~dkg_tpu.dkg.errors.DkgError`: a failed epoch op
+leaves the PREVIOUS epoch's state fully intact (the manager mutates its
+state only after the confirm step), so callers catch EpochError, keep
+serving the old shares, and retry — a ceremony-level DkgError has no
+such "keep the old key" recovery.
+"""
+
+from __future__ import annotations
+
+
+class EpochError(RuntimeError):
+    """One epoch operation (refresh or reshare) failed; the party's
+    previous epoch state is untouched.  ``kind`` is a stable string
+    (NO_DEALERS, INSUFFICIENT_DEALERS, CHURN_LIMIT, CONFIRM_DIVERGENCE,
+    MASTER_DRIFT, NO_GENESIS, BAD_COMMITTEE, NO_PREV_COMMITMENTS,
+    MISSING_SHARE)."""
+
+    def __init__(self, kind: str, detail: str = ""):
+        super().__init__(f"{kind}: {detail}" if detail else kind)
+        self.kind = kind
+        self.detail = detail

@@ -47,6 +47,7 @@ from __future__ import annotations
 import random
 import threading
 import time
+from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from ..utils import obslog
@@ -385,6 +386,217 @@ def run_with_faults(
     for th in threads:
         th.join(timeout=join_timeout)
     return results
+
+
+# ---------------------------------------------------------------------------
+# epoch chaos harness: ceremony + refresh/reshare under churn and faults
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ChurnSchedule:
+    """Mid-sequence membership change for :func:`run_epochs_with_faults`:
+    ``leavers`` (1-based OLD-committee indices) drop out of the reshare's
+    new committee and ``joiners`` fresh members enter it.  Committee size
+    is preserved when ``len(leavers) == joiners`` (the chaos storm's
+    ``--churn K`` shape), but the harness does not require it."""
+
+    leavers: tuple[int, ...]
+    joiners: int
+
+    @property
+    def churn(self) -> int:
+        return len(self.leavers) + self.joiners
+
+
+def churn_schedule(seed: int, n: int, k: int) -> ChurnSchedule:
+    """Seeded K-leave + K-join schedule over an n-party committee."""
+    if not 0 <= k <= n:
+        raise ValueError(f"churn {k} out of range for n={n}")
+    rng = random.Random(seed * 9973 + n * 31 + k)
+    return ChurnSchedule(tuple(sorted(rng.sample(range(1, n + 1), k))), k)
+
+
+@dataclass
+class EpochPartyOutcome:
+    """One worker's end-to-end outcome across ceremony + epoch ops.
+
+    ``party`` is the wrapper id crash/restart faults key on: the old
+    1-based index for founding members, ``n_old + 1 + q`` for joiner
+    ordinal ``q``.  ``masters`` collects ``group.encode(state.master)``
+    after every epoch op this party completed with a share — the chaos
+    assertion is that every entry, from every honest party, is
+    bit-identical to the ceremony's master key.
+    """
+
+    party: int
+    base: object = None  # PartyResult | exception | None (joiners)
+    masters: list = field(default_factory=list)
+    state: object = None  # final EpochState (None for leavers/failures)
+    left: bool = False  # True when this party dealt and exited at the reshare
+    error: object = None  # first exception that ended the worker, if any
+    resumes: int = 0  # respawned incarnations (restart recovery)
+
+
+def run_epochs_with_faults(
+    env,
+    keys,
+    pks,
+    plan: FaultPlan,
+    channel_factory: Callable[[int], BroadcastChannel],
+    *,
+    churn: Optional[ChurnSchedule] = None,
+    refreshes: int = 1,
+    t_new: Optional[int] = None,
+    timeout: float = 5.0,
+    seed: int = 0,
+    join_timeout: float = 600.0,
+    checkpoint_dir: Optional[str] = None,
+):
+    """Run ceremony -> ``refreshes`` proactive refreshes -> one reshare
+    (when ``churn`` is given) with ``plan`` applied to every party on
+    EVERY round — ceremony rounds 1-5 and epoch rounds 6+ alike, since
+    :class:`FaultyChannel` is round-number agnostic.
+
+    Founding parties run the ceremony, seed epoch 0 from their
+    PartyResult, and drive an :class:`~dkg_tpu.epoch.EpochManager` over
+    the SAME wrapped channel and WAL.  Joiners (``churn.joiners`` of
+    them, deterministic keys from ``seed``) participate only in the
+    reshare, bootstrapping the previous aggregate from the deals'
+    t+1-majority claim.  RestartFaults re-spawn the party from its WAL
+    with a fresh rng exactly like :func:`run_with_faults`.
+
+    Returns ``[EpochPartyOutcome]*(n_old + joiners)``, founding members
+    first (index order), then joiners (ordinal order).
+    """
+    from ..dkg.procedure_keys import MemberCommunicationKey
+    from ..epoch import EpochManager, EpochState, genesis_from_party_result
+
+    group = env.group
+    n = env.nr_members
+    t2 = env.threshold if t_new is None else t_new
+    sched = churn if churn is not None else ChurnSchedule((), 0)
+    jrng = random.Random(seed * 7177 + 13)
+    joiner_keys = [
+        MemberCommunicationKey.generate(group, jrng) for _ in range(sched.joiners)
+    ]
+    new_pks = [
+        p for i, p in enumerate(pks) if (i + 1) not in sched.leavers
+    ] + [k.public() for k in joiner_keys]
+    outcomes = [EpochPartyOutcome(party=i + 1) for i in range(n)] + [
+        EpochPartyOutcome(party=n + 1 + q) for q in range(sched.joiners)
+    ]
+    plan.reset_runtime()
+
+    def ops(mgr: "object", out: EpochPartyOutcome, founding: bool) -> None:
+        # A respawned manager re-runs every op from its WAL records
+        # (byte-identical republish, mask-filtered refetch), so each
+        # incarnation simply replays the whole sequence.
+        out.masters = []
+        if founding:
+            for _ in range(refreshes):
+                st = mgr.refresh()
+                out.masters.append(group.encode(st.master))
+                out.state = st
+        if churn is not None:
+            st = mgr.reshare(new_pks, t2)
+            if st is None:
+                out.left = True
+                out.state = None
+            else:
+                out.masters.append(group.encode(st.master))
+                out.state = st
+
+    def founding_worker(i: int) -> None:
+        out = outcomes[i]
+        incarnation = 0
+        while True:
+            chan = FaultyChannel(channel_factory(i), plan, party=i + 1)
+            wal = (
+                wal_path(checkpoint_dir, i + 1)
+                if checkpoint_dir is not None
+                else None
+            )
+            rng = random.Random(seed * 6151 + i + incarnation * 7919)
+            try:
+                res = run_party(
+                    chan, env, keys[i], pks, i + 1, rng,
+                    timeout=timeout, checkpoint=wal,
+                )
+                out.base = res
+                mgr = EpochManager(
+                    chan, group, genesis_from_party_result(env, res),
+                    keys[i], pks, rng,
+                    timeout=timeout, checkpoint=wal, max_churn=None,
+                )
+                ops(mgr, out, founding=True)
+                out.resumes = max(out.resumes, incarnation)
+                return
+            except RestartFault:
+                if checkpoint_dir is None:
+                    out.error = out.error or RestartFault(
+                        f"party {i + 1} restarted without a checkpoint"
+                    )
+                    return
+                incarnation += 1
+            except Exception as exc:  # noqa: BLE001 — surfaced verbatim
+                out.error = exc
+                out.resumes = max(out.resumes, incarnation)
+                return
+
+    def joiner_worker(q: int) -> None:
+        out = outcomes[n + q]
+        party_id = n + 1 + q
+        incarnation = 0
+        while True:
+            chan = FaultyChannel(channel_factory(n + q), plan, party=party_id)
+            wal = (
+                wal_path(checkpoint_dir, party_id)
+                if checkpoint_dir is not None
+                else None
+            )
+            rng = random.Random(seed * 6151 + (n + q) + incarnation * 7919)
+            try:
+                observer = EpochState(
+                    epoch=refreshes, n=n, t=env.threshold,
+                    index=None, share=None, commitments=None,
+                )
+                # the joiner's opening fetch must outlast the whole
+                # preceding sequence: 5 ceremony rounds + 3 per earlier
+                # epoch op, each of which may stall for one full timeout
+                boot = min(join_timeout, timeout * (8 + 3 * refreshes) + 60.0)
+                mgr = EpochManager(
+                    chan, group, observer, joiner_keys[q], pks, rng,
+                    timeout=timeout, first_fetch_timeout=boot,
+                    checkpoint=wal, max_churn=None,
+                    ops_done=refreshes,
+                )
+                ops(mgr, out, founding=False)
+                out.resumes = max(out.resumes, incarnation)
+                return
+            except RestartFault:
+                if checkpoint_dir is None:
+                    out.error = out.error or RestartFault(
+                        f"joiner {party_id} restarted without a checkpoint"
+                    )
+                    return
+                incarnation += 1
+            except Exception as exc:  # noqa: BLE001 — surfaced verbatim
+                out.error = exc
+                out.resumes = max(out.resumes, incarnation)
+                return
+
+    threads = [
+        threading.Thread(target=founding_worker, args=(i,)) for i in range(n)
+    ] + [
+        threading.Thread(target=joiner_worker, args=(q,))
+        for q in range(sched.joiners)
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=join_timeout)
+    return outcomes
 
 
 def honest_results(results, plan: FaultPlan) -> list[PartyResult]:

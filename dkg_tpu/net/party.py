@@ -71,6 +71,13 @@ class PartyResult:
     master: Optional[MasterPublicKey] = None
     share: Optional[MemberSecretShare] = None
     error: Optional[DkgError] = None
+    # aggregate bare commitments (A_0..A_t) of the final sharing poly:
+    # A_l = sum over qualified dealers of A_{j,l}, so A_0 == master and
+    # g*share_i == eval(A, i).  The epoch subsystem (dkg_tpu.epoch)
+    # seeds refresh/resharing from this.  None when any dealer's secret
+    # was reconstructed (the disclosed-share path changes the effective
+    # sharing polynomial, so the aggregate would be stale).
+    commitments: Optional[tuple] = None
     # transport/robustness counters (mirrored into ``trace.counters``)
     quarantined: int = 0  # peer messages that failed decode/validation
     timeouts: int = 0  # rounds that closed before all n messages arrived
@@ -355,7 +362,28 @@ class _PartyRun:
             self.result.error = out
         else:
             self.result.master, self.result.share = out
+            self.result.commitments = self._aggregate_commitments()
         self.finished = True
+
+    def _aggregate_commitments(self) -> Optional[tuple]:
+        """Pointwise sum of the qualified dealers' bare commitment
+        tuples — the Feldman commitments of the AGGREGATE sharing
+        polynomial the final shares lie on.  Only valid when no dealer
+        went through share reconstruction (PartyResult.commitments)."""
+        st = self.phase._state
+        if st.reconstructable:
+            return None
+        qual = [j for j in range(1, self.n + 1) if st.qualified[j - 1]]
+        if not qual or any(j not in st.bare_coeffs for j in qual):
+            return None
+        tlen = len(st.bare_coeffs[qual[0]])
+        agg = []
+        for lvl in range(tlen):
+            acc = st.bare_coeffs[qual[0]][lvl]
+            for j in qual[1:]:
+                acc = self.group.add(acc, st.bare_coeffs[j][lvl])
+            agg.append(acc)
+        return tuple(agg)
 
     _HEADS = {1: _head1, 2: _head2, 3: _head3, 4: _head4, 5: _head5}
 
@@ -366,9 +394,18 @@ class _PartyRun:
         any, is last) plus their raw bodies.  Anything after the first
         gap/corruption is a torn tail and is discarded — resume falls
         back to the previous round, which the write-ahead ordering
-        makes safe."""
+        makes safe.
+
+        Forward compatibility: records whose magic is not ours (e.g.
+        the epoch layer's b"DKGE" records, or record types a future
+        version introduces) are SKIPPED — not interpreted, not treated
+        as corruption — but their bodies are preserved so the torn-tail
+        compaction below never deletes another layer's records."""
         records, bodies = [], []
         for body in self.wal.replay():
+            if not body.startswith(serde.RECORD_MAGIC):
+                bodies.append(body)  # foreign record: preserve, skip
+                continue
             try:
                 rec = serde.decode_round_record(self.group, body)
             except ValueError:
@@ -398,8 +435,13 @@ class _PartyRun:
         if not records:
             # a log that exists but replays to nothing is unusable —
             # recreate it so fresh records don't land after garbage, and
-            # run from round 1 (dropout semantics if the ceremony moved on)
-            self.wal.reset()
+            # run from round 1 (dropout semantics if the ceremony moved
+            # on).  Foreign-magic records (another layer's, e.g. epoch)
+            # are not ours to delete: compact to just those instead.
+            if bodies:
+                self.wal.rewrite(bodies)
+            else:
+                self.wal.reset()
             return 0
         # compact away any torn tail before appending new records: bytes
         # from a half-written frame would shadow everything after them

@@ -18,10 +18,12 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..fields import device as fd
 from ..fields.spec import FieldSpec
+from .host import DuplicateEvaluationPoints
 
 # HBM budget for eval_many's MXU Vandermonde (+ digit) temps; the point
 # axis is chunked to stay under it.  Module-level so tests can shrink it
@@ -108,13 +110,42 @@ def powers(fs: FieldSpec, x: jax.Array, count: int) -> jax.Array:
     return jnp.moveaxis(out, 0, -2)
 
 
+def _check_distinct_nodes_device(fs: FieldSpec, xs) -> None:
+    """Eager duplicate-node guard for the Lagrange kernels.
+
+    Compares limb rows, which is exact for canonically reduced limbs
+    (the fields-layer contract: fh.encode and every fd op emit values
+    < p).  Tracers are skipped — under jit the values are abstract."""
+    if isinstance(xs, jax.core.Tracer):
+        return
+    arr = np.asarray(xs)
+    m = arr.shape[-2]
+    if m <= 1:
+        return
+    flat = arr.reshape(-1, m, arr.shape[-1])
+    for b in range(flat.shape[0]):
+        if len(np.unique(flat[b], axis=0)) != m:
+            raise DuplicateEvaluationPoints(
+                f"duplicate evaluation point among {m} Lagrange nodes "
+                f"(batch {b})"
+            )
+
+
 def lagrange_at_zero_coeffs(fs: FieldSpec, xs: jax.Array) -> jax.Array:
     """Lagrange coefficients lambda_i(0) for nodes xs: (..., M, L) -> same.
 
     lambda_i(0) = prod_{j!=i} x_j / (x_j - x_i).  Numerators via masked
     full-product; denominators inverted with one batched Fermat inversion
     (Montgomery trick in fd.batch_inv).
+
+    Duplicate nodes within one batch would put a zero factor in a
+    denominator and make the Fermat inversion return garbage silently;
+    eager (concrete) inputs therefore raise the typed
+    :class:`~dkg_tpu.poly.host.DuplicateEvaluationPoints` up front.
+    Inside a trace (jit/vmap) values are abstract and the check is
+    skipped — jitted callers own node distinctness.
     """
+    _check_distinct_nodes_device(fs, xs)
     m = xs.shape[-2]
     xi = xs[..., :, None, :]  # (..., M, 1, L)
     xj = xs[..., None, :, :]  # (..., 1, M, L)

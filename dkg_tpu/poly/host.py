@@ -64,9 +64,34 @@ class Polynomial:
         return Polynomial(self.fs, tuple(out))
 
 
+class DuplicateEvaluationPoints(ValueError):
+    """Two Lagrange interpolation nodes coincide (mod p).
+
+    The basis denominators prod (x_j - x_i) contain a zero factor, so
+    the Fermat/Montgomery inversions silently return garbage instead of
+    failing — every interpolation entry point (host and device) raises
+    this typed error up front instead."""
+
+
+def check_distinct_nodes(fs: FieldSpec, xs) -> None:
+    """Raise :class:`DuplicateEvaluationPoints` unless all nodes are
+    distinct mod p."""
+    p = fs.modulus
+    seen: dict[int, int] = {}
+    for k, x in enumerate(xs):
+        r = int(x) % p
+        if r in seen:
+            raise DuplicateEvaluationPoints(
+                f"duplicate evaluation point x={r} at positions "
+                f"{seen[r]} and {k}"
+            )
+        seen[r] = k
+
+
 def lagrange_coefficient(fs: FieldSpec, eval_point: int, i: int, xs) -> int:
     """lambda_i(eval_point) = prod_{j != i} (x_j - e)/(x_j - x_i)
     (reference: polynomial.rs:162-170)."""
+    check_distinct_nodes(fs, xs)
     p = fs.modulus
     num, den = 1, 1
     for j, xj in enumerate(xs):
@@ -83,6 +108,7 @@ def lagrange_interpolation(fs: FieldSpec, eval_point: int, ys, xs) -> int:
     Protocol use: share reconstruction at 0 (committee.rs:784-789)."""
     if len(xs) != len(ys):
         raise ValueError("xs and ys must have equal length")
+    check_distinct_nodes(fs, xs)
     p = fs.modulus
     acc = 0
     for i, yi in enumerate(ys):
@@ -94,6 +120,7 @@ def interpolate(fs: FieldSpec, xs, ys) -> Polynomial:
     """Full polynomial interpolation (reference: polynomial.rs:92-110)."""
     if len(xs) != len(ys) or not xs:
         raise ValueError("need equal-length non-empty xs, ys")
+    check_distinct_nodes(fs, xs)
     p = fs.modulus
     result = Polynomial(fs, (0,))
     for i, (xi, yi) in enumerate(zip(xs, ys)):

@@ -128,6 +128,10 @@ class CeremonyOutcome:
     #: time.monotonic() stamp set by the scheduler when the outcome was
     #: recorded — lets clients compute queue-to-completion latency
     completed_at: float = 0.0
+    #: epoch counter of the held sharing: 0 at the ceremony, +1 per
+    #: completed refresh/reshare against this outcome (the scheduler's
+    #: epoch methods CAS on it).  ``master`` never changes with it.
+    epoch: int = 0
     final_shares: np.ndarray | None = dataclasses.field(
         default=None, repr=False, compare=False
     )

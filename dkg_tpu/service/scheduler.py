@@ -43,9 +43,16 @@ journal directory, unset = durability off).
 
 from __future__ import annotations
 
+import hashlib
+import random
 import threading
 import time
 
+import numpy as np
+
+from ..epoch import inprocess as epoch_inprocess
+from ..fields import host as fh
+from ..groups import host as gh
 from ..utils import envknobs
 from ..utils.metrics import REGISTRY
 from . import buckets
@@ -272,6 +279,114 @@ class CeremonyScheduler:
                         )
                 self._cond.wait(timeout=remain)
             return self._results[cid]
+
+    # -- epoch operations against a held outcome ----------------------------
+
+    def _held_outcome(self, cid: str) -> CeremonyOutcome:
+        """The live, share-holding outcome for an epoch op.  KeyError for
+        unknown ids, ValueError for non-terminal / failed / share-less
+        (journal-recovered or retired) outcomes — callers see exactly
+        which precondition failed."""
+        out = self._results.get(cid)
+        if out is None:
+            if cid in self._status:
+                raise ValueError(
+                    f"ceremony {cid} is still {self._status[cid]}"
+                )
+            raise KeyError(f"unknown ceremony id {cid!r}")
+        if out.status != "done":
+            raise ValueError(f"ceremony {cid} is {out.status}, not done")
+        if out.final_shares is None:
+            raise ValueError(
+                f"ceremony {cid} holds no shares (journal-recovered "
+                "outcomes and retired epochs serve results only)"
+            )
+        return out
+
+    def refresh(self, cid: str, seed: int | None = None) -> int:
+        """Proactively refresh the held shares of ceremony ``cid`` in
+        place: every share changes, the master key (and the outcome's
+        public surface) does not.  Returns the new epoch number.
+
+        Runs on the caller's thread — the work is one batched device
+        evaluation (dkg_tpu.epoch.inprocess), far below convoy cost, so
+        it does not compete through the admission queue.  Concurrent
+        epoch ops on the same ceremony are detected by an epoch-counter
+        CAS and rejected with ValueError.
+        """
+        t0 = time.monotonic()
+        with self._cond:
+            out = self._held_outcome(cid)
+            token = out.epoch
+            fs = gh.ALL_GROUPS[out.curve].scalar_field
+            shares = [int(v) for v in fh.decode(fs, out.final_shares)]
+        rng = random.Random(seed) if seed is not None else random.SystemRandom()
+        new = epoch_inprocess.refresh_shares(fs, out.n, out.t, shares, rng)
+        with self._cond:
+            if self._results.get(cid) is not out or out.epoch != token:
+                raise ValueError(f"concurrent epoch operation on {cid}")
+            out.final_shares = np.asarray(fh.encode(fs, new))
+            out.epoch = token + 1
+        self.metrics.inc("service_epochs_total", kind="refresh")
+        self.metrics.observe(
+            "service_epoch_seconds", time.monotonic() - t0, kind="refresh"
+        )
+        return token + 1
+
+    def reshare(
+        self,
+        cid: str,
+        n_new: int,
+        t_new: int,
+        seed: int | None = None,
+    ) -> str:
+        """Reshare ceremony ``cid``'s secret into a fresh (n_new, t_new)
+        sharing held under a NEW ceremony id (returned).  The source
+        outcome is RETIRED — its shares are dropped (proactive security:
+        two live sharings of one secret double the exposure) and further
+        epoch ops on it fail; its public result stays served.  The new
+        outcome carries the same master key, ``epoch`` advanced by one.
+        """
+        if not (1 <= t_new < (n_new + 1) / 2):
+            raise ValueError(
+                f"threshold must satisfy 1 <= t < (n+1)/2, got "
+                f"t={t_new} n={n_new}"
+            )
+        t0 = time.monotonic()
+        with self._cond:
+            out = self._held_outcome(cid)
+            token = out.epoch
+            fs = gh.ALL_GROUPS[out.curve].scalar_field
+            shares = [int(v) for v in fh.decode(fs, out.final_shares)]
+        rng = random.Random(seed) if seed is not None else random.SystemRandom()
+        new = epoch_inprocess.reshare_shares(
+            fs, out.n, out.t, shares, n_new, t_new, rng
+        )
+        h = hashlib.blake2b(digest_size=6)
+        h.update(f"reshare|{cid}|{n_new}|{t_new}|{token + 1}".encode())
+        new_cid = h.hexdigest()
+        new_out = CeremonyOutcome(
+            ceremony_id=new_cid,
+            status="done",
+            curve=out.curve,
+            n=n_new,
+            t=t_new,
+            master=out.master,
+            qualified=(True,) * n_new,
+            epoch=token + 1,
+            final_shares=np.asarray(fh.encode(fs, new)),
+        )
+        with self._cond:
+            if self._results.get(cid) is not out or out.epoch != token:
+                raise ValueError(f"concurrent epoch operation on {cid}")
+            out.final_shares = None  # retire the old sharing
+            out.epoch = token + 1
+            self._record(new_out)
+        self.metrics.inc("service_epochs_total", kind="reshare")
+        self.metrics.observe(
+            "service_epoch_seconds", time.monotonic() - t0, kind="reshare"
+        )
+        return new_cid
 
     # -- worker side --------------------------------------------------------
 

@@ -18,7 +18,11 @@ DKG_TPU_SERVICE_CONCURRENCY / DKG_TPU_SERVICE_QUEUE_DEPTH /
 DKG_TPU_SERVICE_BATCH_MAX / DKG_TPU_SERVICE_DEADLINE_S /
 DKG_TPU_SERVICE_WAL_DIR scheduler knobs via service.scheduler —
 lint rule DKG007 bans any other environment access in
-dkg_tpu/service/).
+dkg_tpu/service/,
+DKG_TPU_EPOCH_MAX_CHURN (leave+join budget a reshare accepts; 0
+refuses any membership change) and DKG_TPU_EPOCH_DEADLINE_S
+(per-epoch-round fetch timeout) via dkg_tpu.epoch.manager — lint
+rule DKG008 likewise bans raw environment access in dkg_tpu/epoch/).
 
 An EMPTY value is everywhere treated as unset: ``DKG_TPU_X= cmd`` is
 the shell idiom for clearing a knob on one invocation, and must select

@@ -499,3 +499,96 @@ def decode_round_record(group: HostGroup, data: bytes) -> RoundRecord:
         round_no, payload, phase, error, drain_from,
         present, quarantined_delta, timed_out,
     )
+
+
+# ---------------------------------------------------------------------------
+# WAL epoch records (dkg_tpu.epoch — proactive refresh / resharing)
+# ---------------------------------------------------------------------------
+
+EPOCH_RECORD_MAGIC = b"DKGE"
+
+# Epoch-op steps (one WAL record per step, written BEFORE the step's
+# publish — the same write-ahead contract as round records): 1 = deal,
+# 2 = complaints, 3 = confirm.  The step-3 record optionally pins the
+# resulting EpochState bytes (absent for leavers, who deal but hold no
+# share in the new committee).
+EPOCH_STEP_DEAL = 1
+EPOCH_STEP_COMPLAINTS = 2
+EPOCH_STEP_CONFIRM = 3
+
+
+@dataclass(frozen=True)
+class EpochRecord:
+    """One replayed epoch WAL record (see dkg_tpu.epoch.manager).
+
+    ``payload`` is the exact wire bytes published for this step (empty
+    for steps the party does not publish, e.g. a joiner's deal step).
+    ``present`` is the sender set observed in the PREVIOUS step's fetch
+    (None for the deal step) — re-decoding those mailbox entries is
+    deterministic, so the mask reconstructs the original view.
+    ``state_bytes`` is the serialized EpochState the confirm step
+    produced (None otherwise); the epoch layer owns its codec — this
+    record treats both byte fields as opaque, which is exactly what
+    keeps pre-epoch readers able to SKIP these records by magic alone
+    (net.party forward-compatibility).
+    """
+
+    op_seq: int
+    step: int
+    kind: int
+    payload: bytes
+    present: Optional[tuple[int, ...]]
+    state_bytes: Optional[bytes]
+
+
+def encode_epoch_record(
+    group: HostGroup,
+    op_seq: int,
+    step: int,
+    kind: int,
+    payload: bytes,
+    *,
+    present: Optional[tuple[int, ...]] = None,
+    state_bytes: Optional[bytes] = None,
+) -> bytes:
+    """Serialize one epoch WAL record (magic b"DKGE", version-tagged)."""
+    w = Writer(group)
+    w.raw(EPOCH_RECORD_MAGIC)
+    w.u8(VERSION)
+    w.u16(op_seq)
+    w.u8(step)
+    w.u8(kind)
+    w.lp(payload)
+    w.u8(1 if present is not None else 0)
+    if present is not None:
+        w.u16(len(present))
+        for j in present:
+            w.u16(j)
+    w.u8(1 if state_bytes is not None else 0)
+    if state_bytes is not None:
+        w.lp(state_bytes)
+    return w.bytes()
+
+
+def decode_epoch_record(group: HostGroup, data: bytes) -> EpochRecord:
+    """Rebuild one epoch WAL record; raises ValueError on malformed
+    input (torn tail, same contract as decode_round_record)."""
+    r = Reader(group, data)
+    if r.take(4) != EPOCH_RECORD_MAGIC:
+        raise ValueError("bad epoch record magic")
+    if r.u8() != VERSION:
+        raise ValueError("unsupported epoch record version")
+    op_seq = r.u16()
+    step = r.u8()
+    kind = r.u8()
+    if step not in (EPOCH_STEP_DEAL, EPOCH_STEP_COMPLAINTS, EPOCH_STEP_CONFIRM):
+        raise ValueError("unknown epoch record step")
+    payload = r.lp()
+    present: Optional[tuple[int, ...]] = None
+    if r.u8():
+        present = tuple(r.u16() for _ in range(r.u16()))
+    state_bytes: Optional[bytes] = None
+    if r.u8():
+        state_bytes = r.lp()
+    r.done()
+    return EpochRecord(op_seq, step, kind, payload, present, state_bytes)

@@ -61,6 +61,17 @@ linter), so the committed baseline stays clean between CI runs:
         outside ``scheduler.py``: the scheduler's worker pool is the ONE
         place service code may create execution contexts, so
         concurrency has a single auditable owner (docs/service.md)
+* DKG008  (dkg_tpu/epoch/ only) per-pair EC scalar work or ad-hoc
+        persistence in epoch code: a ``scalar_mul``/
+        ``scalar_mul_vartime`` call lexically inside a loop — epoch
+        dealing/verification must go through the batched ceremony
+        entry points (``deal_chunked``, ``open_shares_batch``,
+        ``gd.fixed_base_mul``/``gd.eval_point_poly``/``gd.scalar_mul``
+        over stacked rows; epoch/dealing.py) so refresh cost scales
+        like the ceremony, not like n^2 host mults — or a raw file
+        write: epoch state (it contains shares) persists ONLY through
+        the party WAL (``net.checkpoint.PartyWal`` epoch records;
+        docs/resharing.md)
 
 Exit 0 = clean.  Run: ``python scripts/lint_lite.py`` (from repo root).
 Also executed by tests/test_import_hygiene.py so the default test tier
@@ -150,6 +161,12 @@ _SERVICE_SPAWNERS = {
 }
 _SERVICE_SPAWN_OWNER = "scheduler.py"
 
+# Per-pair EC scalar multiplication entry points banned inside loops in
+# dkg_tpu/epoch/ (DKG008): a host scalar_mul per (dealer, recipient)
+# pair is the O(n^2) pathology the batched kernels exist to avoid.
+# (Batched gd.scalar_mul over stacked rows sits OUTSIDE any loop.)
+_EPOCH_SCALAR_MULS = {"scalar_mul", "scalar_mul_vartime"}
+
 
 class _Checker(ast.NodeVisitor):
     def __init__(self, path: pathlib.Path, tree: ast.Module, source: str):
@@ -165,6 +182,7 @@ class _Checker(ast.NodeVisitor):
         self._dkg_module = "dkg_tpu/dkg/" in path.as_posix()
         self._pkg_module = "dkg_tpu/" in path.as_posix()
         self._service_module = "dkg_tpu/service/" in path.as_posix()
+        self._epoch_module = "dkg_tpu/epoch/" in path.as_posix()
         self._dem_hot_module = (
             self._dkg_module and path.name in _DEM_HOT_MODULES
         )
@@ -471,6 +489,33 @@ class _Checker(ast.NodeVisitor):
                     f"{name}() in dkg_tpu/service/ — the scheduler's "
                     "worker pool (service/scheduler.py) is the only "
                     "sanctioned thread/process spawn site",
+                )
+        # DKG008: epoch code must scale like the ceremony — EC scalar
+        # mults go through the batched entry points (epoch/dealing.py),
+        # never one host scalar_mul per pair in a loop — and epoch state
+        # (shares!) persists only through the party WAL.
+        if self._epoch_module:
+            func = node.func
+            name = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else ""
+            )
+            if name in _EPOCH_SCALAR_MULS and self._loop_depth > 0:
+                self._add(
+                    node,
+                    "DKG008",
+                    f"{name}() inside a loop in dkg_tpu/epoch/ — use the "
+                    "batched dealing/verify entry points (deal_chunked, "
+                    "open_shares_batch, gd.fixed_base_mul/eval_point_poly/"
+                    "scalar_mul over stacked rows)",
+                )
+            wname = self._raw_write_name(node)
+            if wname:
+                self._add(
+                    node,
+                    "DKG008",
+                    f"raw file write ({wname}) in dkg_tpu/epoch/ — epoch "
+                    "state persists only through net.checkpoint.PartyWal "
+                    "epoch records",
                 )
         # DKG004b: a hashlib.blake2b call lexically inside a loop in a
         # batch hot module is a per-dealer host hash loop — use

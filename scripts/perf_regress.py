@@ -39,6 +39,13 @@ regression for a service).  The same forgiveness rules apply: fewer
 than two comparable fleet rounds, mismatched platforms, or mismatched
 service shapes (concurrency/batch_max) skip with a note.
 
+The epoch subsystem likewise: ``EPOCH_r{NN}.json`` rounds
+(scripts/epoch_bench.py) are diffed newest-two — FAIL when
+``refreshes_per_s`` dropped more than the threshold (reshare wall-clock
+is reported but informational: a single op's wall time on a shared box
+is too noisy to gate).  Mismatched platforms or committee shapes
+(n/t/curve) skip with a note.
+
 Run: ``python scripts/perf_regress.py [--threshold 0.2] [dir]``.
 """
 
@@ -52,6 +59,7 @@ import sys
 
 _PAT = re.compile(r"BENCH_r(\d+)\.json$")
 _FLEET_PAT = re.compile(r"FLEET_r(\d+)\.json$")
+_EPOCH_PAT = re.compile(r"EPOCH_r(\d+)\.json$")
 
 
 def _load_rounds(root: pathlib.Path) -> list[tuple[int, dict]]:
@@ -93,7 +101,9 @@ def main(argv: list[str] | None = None) -> int:
         else pathlib.Path(__file__).resolve().parent.parent
     )
 
-    fleet_bad = fleet_gate(root, args.threshold)
+    fleet_bad = fleet_gate(root, args.threshold) or epoch_gate(
+        root, args.threshold
+    )
 
     rounds = _load_rounds(root)
     if len(rounds) < 2:
@@ -251,6 +261,68 @@ def fleet_gate(root: pathlib.Path, threshold: float) -> int:
             bad = 1
         else:
             print(line)
+    return bad
+
+
+def _load_epoch_rounds(root: pathlib.Path) -> list[tuple[int, dict]]:
+    """(round number, epoch report) for every usable epoch round,
+    ascending — usable means a positive refresh throughput."""
+    out: list[tuple[int, dict]] = []
+    for path in sorted(root.glob("EPOCH_r*.json")):
+        m = _EPOCH_PAT.search(path.name)
+        if not m:
+            continue
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError):
+            continue
+        rate = doc.get("refreshes_per_s") if isinstance(doc, dict) else None
+        if not isinstance(rate, (int, float)) or rate <= 0:
+            continue
+        out.append((int(m.group(1)), doc))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+def epoch_gate(root: pathlib.Path, threshold: float) -> int:
+    """Diff the newest two epoch rounds: refresh throughput must not
+    DROP beyond the threshold.  Reshare wall-clock is printed but not
+    gated (single-op wall time is noise-bound on shared hosts)."""
+    rounds = _load_epoch_rounds(root)
+    if len(rounds) < 2:
+        print(
+            f"perf_regress: {len(rounds)} usable epoch round(s) in {root} "
+            "— nothing to diff"
+        )
+        return 0
+    (old_n, old), (new_n, new) = rounds[-2], rounds[-1]
+    for key in ("platform", "curve", "n", "t"):
+        old_v, new_v = old.get(key), new.get(key)
+        if old_v != new_v:
+            print(
+                f"perf_regress: epoch r{old_n} ({key}={old_v}) vs "
+                f"r{new_n} ({key}={new_v}) measured different shapes "
+                "— incomparable, skipping"
+            )
+            return 0
+    old_v, new_v = old.get("refreshes_per_s"), new.get("refreshes_per_s")
+    change = (new_v - old_v) / old_v
+    line = (
+        f"perf_regress: epoch refreshes_per_s r{old_n} {old_v:.3f} -> "
+        f"r{new_n} {new_v:.3f} refreshes/s ({change:+.1%})"
+    )
+    bad = 0
+    if change < -threshold:
+        print(f"{line} — REGRESSION beyond {threshold:.0%}", file=sys.stderr)
+        bad = 1
+    else:
+        print(line)
+    rw_old, rw_new = old.get("reshare_wall_s"), new.get("reshare_wall_s")
+    if isinstance(rw_old, (int, float)) and isinstance(rw_new, (int, float)):
+        print(
+            f"perf_regress: epoch reshare_wall_s r{old_n} {rw_old:.3f} -> "
+            f"r{new_n} {rw_new:.3f} s — informational, not gated"
+        )
     return bad
 
 

@@ -1,0 +1,700 @@
+"""Epoch subsystem tests: codecs and validation (fast tier) plus the
+networked refresh/reshare integration and the churn-safe chaos
+acceptance run (``slow``).
+
+The fast tier stays host-only — no channel, no device dispatch: record
+and message codecs, state encoding, env-knob validation, WAL
+coexistence with ceremony records, churn-schedule determinism, and the
+DKG008 lint / EPOCH perf-gate units.  Everything that compiles a
+kernel or spins up party threads is marked ``slow``.
+"""
+
+import random
+import threading
+from types import SimpleNamespace
+
+import pytest
+
+from dkg_tpu.dkg.procedure_keys import MemberCommunicationKey
+from dkg_tpu.epoch import (
+    EPOCH_ROUND_BASE,
+    ROUNDS_PER_OP,
+    EpochError,
+    EpochManager,
+    EpochState,
+    KIND_REFRESH,
+    KIND_RESHARE,
+    confirm_digest,
+    decode_epoch_state,
+    encode_epoch_state,
+    epoch_rounds,
+    genesis_from_party_result,
+)
+from dkg_tpu.epoch import messages as em
+from dkg_tpu.groups import host as gh
+from dkg_tpu.net import InProcessChannel, PartyWal
+from dkg_tpu.net.faults import (
+    ChurnSchedule,
+    FaultPlan,
+    churn_schedule,
+    make_committee,
+    run_epochs_with_faults,
+)
+from dkg_tpu.utils import serde
+
+G = gh.RISTRETTO255
+RNG = random.Random(0xE90C)
+
+_DECODE_ERRORS = (ValueError, IndexError, OverflowError)
+
+
+def _points(k: int) -> tuple:
+    """k distinct cheap points: i * G for i = 1..k."""
+    return tuple(G.scalar_mul(i, G.generator()) for i in range(1, k + 1))
+
+
+def _observer(epoch: int = 0, n: int = 3, t: int = 1, **kw) -> EpochState:
+    return EpochState(
+        epoch=epoch, n=n, t=t, index=None, share=None, commitments=None, **kw
+    )
+
+
+# ---------------------------------------------------------------------------
+# round layout
+# ---------------------------------------------------------------------------
+
+
+def test_epoch_rounds_never_collide_with_ceremony_rounds():
+    assert EPOCH_ROUND_BASE == 6 and ROUNDS_PER_OP == 3
+    assert epoch_rounds(1) == (6, 7, 8)
+    assert epoch_rounds(2) == (9, 10, 11)
+    seen: set = set()
+    for op in range(1, 20):
+        rounds = epoch_rounds(op)
+        assert all(r > 5 for r in rounds)  # ceremony owns rounds 1..5
+        assert not seen & set(rounds)  # ops never share a round
+        seen |= set(rounds)
+
+
+# ---------------------------------------------------------------------------
+# WAL epoch records (serde b"DKGE")
+# ---------------------------------------------------------------------------
+
+
+def test_epoch_record_roundtrip_all_field_shapes():
+    for payload, present, state_bytes in [
+        (b"", None, None),
+        (b"deal-bytes", None, None),
+        (b"complaints", (1, 3, 7), None),
+        (b"confirm", (2,), b"state-blob"),
+        (b"", (), b""),
+    ]:
+        body = serde.encode_epoch_record(
+            G, 4, serde.EPOCH_STEP_CONFIRM, KIND_RESHARE, payload,
+            present=present, state_bytes=state_bytes,
+        )
+        rec = serde.decode_epoch_record(G, body)
+        assert (rec.op_seq, rec.step, rec.kind) == (
+            4, serde.EPOCH_STEP_CONFIRM, KIND_RESHARE
+        )
+        assert rec.payload == payload
+        assert rec.present == present
+        assert rec.state_bytes == state_bytes
+
+
+def test_epoch_record_rejects_malformed_bytes():
+    good = serde.encode_epoch_record(
+        G, 1, serde.EPOCH_STEP_DEAL, KIND_REFRESH, b"x" * 40, present=(1, 2)
+    )
+    # wrong magic: the ceremony layer's records must not decode here
+    with pytest.raises(ValueError):
+        serde.decode_epoch_record(G, serde.RECORD_MAGIC + good[4:])
+    # unknown step byte
+    bad_step = bytearray(good)
+    bad_step[7] = 9
+    with pytest.raises(ValueError):
+        serde.decode_epoch_record(G, bytes(bad_step))
+    # torn tail: every strict prefix fails, none is misread as valid
+    for cut in range(len(good)):
+        with pytest.raises(_DECODE_ERRORS):
+            serde.decode_epoch_record(G, good[:cut])
+    # trailing garbage is rejected too (r.done())
+    with pytest.raises(ValueError):
+        serde.decode_epoch_record(G, good + b"\x00")
+
+
+# ---------------------------------------------------------------------------
+# epoch state + confirm digest
+# ---------------------------------------------------------------------------
+
+
+def test_epoch_state_codec_roundtrip():
+    full = EpochState(
+        epoch=3, n=5, t=2, index=4,
+        share=G.scalar_field.rand_int(RNG), commitments=_points(3),
+    )
+    got = decode_epoch_state(G, encode_epoch_state(G, full))
+    assert (got.epoch, got.n, got.t, got.index, got.share) == (3, 5, 2, 4, full.share)
+    assert len(got.commitments) == 3
+    assert all(G.eq(a, b) for a, b in zip(got.commitments, full.commitments))
+    assert got.holds_share and G.eq(got.master, full.commitments[0])
+
+    obs = _observer(epoch=1)
+    got = decode_epoch_state(G, encode_epoch_state(G, obs))
+    assert got == obs and not got.holds_share and got.master is None
+
+    with pytest.raises(_DECODE_ERRORS):
+        decode_epoch_state(G, encode_epoch_state(G, full)[:-2])
+
+
+def test_confirm_digest_binds_every_field():
+    cs = _points(2)
+    base = confirm_digest(G, KIND_REFRESH, 1, 5, 2, cs)
+    assert len(base) == 16
+    assert confirm_digest(G, KIND_REFRESH, 1, 5, 2, cs) == base
+    others = [
+        confirm_digest(G, KIND_RESHARE, 1, 5, 2, cs),
+        confirm_digest(G, KIND_REFRESH, 2, 5, 2, cs),
+        confirm_digest(G, KIND_REFRESH, 1, 6, 2, cs),
+        confirm_digest(G, KIND_REFRESH, 1, 5, 3, cs),
+        confirm_digest(G, KIND_REFRESH, 1, 5, 2, cs[:1]),
+        confirm_digest(G, KIND_REFRESH, 1, 5, 2, (cs[1], cs[0])),
+    ]
+    assert len({base, *others}) == len(others) + 1
+
+
+def test_genesis_requires_ok_result_with_commitments():
+    env = SimpleNamespace(nr_members=3, threshold=1)
+    ok = SimpleNamespace(
+        ok=True, index=2, share=SimpleNamespace(value=7), commitments=_points(2)
+    )
+    st = genesis_from_party_result(env, ok)
+    assert (st.epoch, st.n, st.t, st.index, st.share) == (0, 3, 1, 2, 7)
+
+    for bad in [
+        SimpleNamespace(ok=False, index=1, share=None, commitments=None),
+        SimpleNamespace(ok=True, index=1, share=None, commitments=_points(2)),
+        SimpleNamespace(
+            ok=True, index=1, share=SimpleNamespace(value=7), commitments=None
+        ),
+    ]:
+        with pytest.raises(EpochError) as ei:
+            genesis_from_party_result(env, bad)
+        assert ei.value.kind == "NO_GENESIS"
+
+
+# ---------------------------------------------------------------------------
+# wire message codecs
+# ---------------------------------------------------------------------------
+
+
+def test_epoch_complaints_and_confirm_roundtrip():
+    c = em.EpochComplaints(KIND_REFRESH, 2, (3, 5))
+    assert em.decode_epoch_complaints(G, em.encode_epoch_complaints(G, c)) == c
+    empty = em.EpochComplaints(KIND_RESHARE, 1, ())
+    assert em.decode_epoch_complaints(G, em.encode_epoch_complaints(G, empty)) == empty
+
+    f = em.EpochConfirm(KIND_RESHARE, 4, bytes(range(16)))
+    assert em.decode_epoch_confirm(G, em.encode_epoch_confirm(G, f)) == f
+
+
+def test_epoch_deal_roundtrip_and_rejection():
+    d = em.EpochDeal(
+        kind=KIND_RESHARE, epoch=2, commitments=_points(3),
+        encrypted_shares=(), prev_commitments=_points(2),
+    )
+    got = em.decode_epoch_deal(G, em.encode_epoch_deal(G, d))
+    assert got.kind == KIND_RESHARE and got.epoch == 2
+    assert len(got.commitments) == 3 and len(got.prev_commitments) == 2
+    assert got.shares_for(1) is None  # no sealed share for index 1
+
+    # unknown kind byte
+    raw = bytearray(em.encode_epoch_deal(G, d))
+    raw[0] = 9
+    with pytest.raises(ValueError):
+        em.decode_epoch_deal(G, bytes(raw))
+    # confirm digest must be exactly 16 bytes
+    short = em.EpochConfirm(KIND_REFRESH, 1, b"short")
+    with pytest.raises(ValueError):
+        em.decode_epoch_confirm(G, em.encode_epoch_confirm(G, short))
+    # truncations never decode
+    body = em.encode_epoch_complaints(G, em.EpochComplaints(KIND_REFRESH, 1, (2,)))
+    for cut in range(len(body)):
+        with pytest.raises(_DECODE_ERRORS):
+            em.decode_epoch_complaints(G, body[:cut])
+
+
+# ---------------------------------------------------------------------------
+# env knobs + manager validation (no channel interaction)
+# ---------------------------------------------------------------------------
+
+
+def test_epoch_env_knobs_validated(monkeypatch):
+    monkeypatch.setenv("DKG_TPU_EPOCH_DEADLINE_S", "2.5")
+    monkeypatch.setenv("DKG_TPU_EPOCH_MAX_CHURN", "3")
+    mgr = EpochManager(None, G, _observer(), None, [], None)
+    assert mgr.timeout == 2.5 and mgr.max_churn == 3
+
+    monkeypatch.setenv("DKG_TPU_EPOCH_DEADLINE_S", "not-a-number")
+    with pytest.raises(ValueError, match="DKG_TPU_EPOCH_DEADLINE_S"):
+        EpochManager(None, G, _observer(), None, [], None)
+    monkeypatch.setenv("DKG_TPU_EPOCH_DEADLINE_S", "-1")
+    with pytest.raises(ValueError):
+        EpochManager(None, G, _observer(), None, [], None)
+
+    monkeypatch.setenv("DKG_TPU_EPOCH_DEADLINE_S", "2.5")
+    monkeypatch.setenv("DKG_TPU_EPOCH_MAX_CHURN", "-2")
+    with pytest.raises(ValueError, match="DKG_TPU_EPOCH_MAX_CHURN"):
+        EpochManager(None, G, _observer(), None, [], None)
+
+    # explicit arguments always win over the knobs
+    monkeypatch.setenv("DKG_TPU_EPOCH_MAX_CHURN", "0")
+    mgr = EpochManager(
+        None, G, _observer(), None, [], None, timeout=1.0, max_churn=9
+    )
+    assert mgr.timeout == 1.0 and mgr.max_churn == 9
+
+    monkeypatch.delenv("DKG_TPU_EPOCH_DEADLINE_S")
+    monkeypatch.delenv("DKG_TPU_EPOCH_MAX_CHURN")
+    mgr = EpochManager(None, G, _observer(), None, [], None)
+    assert mgr.timeout == 30.0 and mgr.max_churn is None
+
+
+def test_reshare_validates_committee_before_any_round():
+    pks = [
+        MemberCommunicationKey.generate(G, random.Random(i)).public()
+        for i in range(4)
+    ]
+    mgr = EpochManager(
+        None, G, _observer(), None, [], None, timeout=0.1, max_churn=0
+    )
+    with pytest.raises(EpochError) as ei:  # t' too large for n'=3
+        mgr.reshare(pks[:3], 2)
+    assert ei.value.kind == "BAD_COMMITTEE"
+    with pytest.raises(EpochError) as ei:  # t' < 1
+        mgr.reshare(pks[:3], 0)
+    assert ei.value.kind == "BAD_COMMITTEE"
+    with pytest.raises(EpochError) as ei:  # duplicate member keys
+        mgr.reshare([pks[0], pks[0], pks[1]], 1)
+    assert ei.value.kind == "BAD_COMMITTEE"
+    with pytest.raises(EpochError) as ei:  # 3 joiners vs max_churn=0
+        mgr.reshare(pks[:3], 1)
+    assert ei.value.kind == "CHURN_LIMIT"
+
+    with pytest.raises(EpochError) as ei:  # refresh needs an aggregate
+        mgr.refresh()
+    assert ei.value.kind == "NO_GENESIS"
+
+
+def test_bad_state_index_vs_committee_is_rejected():
+    keys = [MemberCommunicationKey.generate(G, random.Random(i)) for i in range(2)]
+    pks = [k.public() for k in keys]
+    st = EpochState(
+        epoch=0, n=2, t=1, index=2, share=5, commitments=_points(2)
+    )
+    # index 2 must hold key pks[1]; presenting keys[0] is a mismatch
+    with pytest.raises(EpochError) as ei:
+        EpochManager(None, G, st, keys[0], pks, None, timeout=0.1)
+    assert ei.value.kind == "BAD_COMMITTEE"
+
+
+# ---------------------------------------------------------------------------
+# WAL coexistence: ceremony DKGR records + epoch DKGE records, one log
+# ---------------------------------------------------------------------------
+
+
+def test_manager_replay_skips_foreign_records_and_torn_tail(tmp_path):
+    wal = PartyWal(tmp_path / "p.wal")
+    # a ceremony record, an unknown future record type, then two epoch
+    # records — the manager must replay exactly the epoch ones
+    wal.append(serde.RECORD_MAGIC + b"ceremony-opaque-body")
+    wal.append(b"DKGZ" + b"future-layer-body")
+    wal.append(
+        serde.encode_epoch_record(G, 1, serde.EPOCH_STEP_DEAL, KIND_REFRESH, b"d1")
+    )
+    wal.append(
+        serde.encode_epoch_record(
+            G, 1, serde.EPOCH_STEP_COMPLAINTS, KIND_REFRESH, b"c1", present=(1, 2)
+        )
+    )
+    mgr = EpochManager(None, G, _observer(), None, [], None, checkpoint=wal)
+    assert set(mgr._replayed) == {1}
+    assert set(mgr._replayed[1]) == {
+        serde.EPOCH_STEP_DEAL, serde.EPOCH_STEP_COMPLAINTS
+    }
+    assert mgr._replayed[1][serde.EPOCH_STEP_COMPLAINTS].present == (1, 2)
+
+    # byte-truncate the file mid-record: the torn frame disappears, the
+    # intact prefix (including the foreign records) survives
+    raw = (tmp_path / "p.wal").read_bytes()
+    (tmp_path / "p.wal").write_bytes(raw[:-7])
+    mgr = EpochManager(
+        None, G, _observer(), None, [], None, checkpoint=tmp_path / "p.wal"
+    )
+    assert set(mgr._replayed[1]) == {serde.EPOCH_STEP_DEAL}
+
+
+def test_party_replay_preserves_epoch_records(tmp_path):
+    """net.party's resume must SKIP b"DKGE" records without treating
+    them as corruption, and compaction must keep their bodies."""
+    from dkg_tpu.net.party import _PartyRun
+
+    wal = PartyWal(tmp_path / "p.wal")
+    epoch_body = serde.encode_epoch_record(
+        G, 1, serde.EPOCH_STEP_DEAL, KIND_REFRESH, b"deal"
+    )
+    wal.append(epoch_body)
+    run = object.__new__(_PartyRun)
+    run.wal, run.group = wal, G
+    records, bodies = run._replay_records()
+    assert records == [] and bodies == [epoch_body]
+
+
+# ---------------------------------------------------------------------------
+# churn schedules
+# ---------------------------------------------------------------------------
+
+
+def test_churn_schedule_is_deterministic_and_bounded():
+    a = churn_schedule(7, 8, 2)
+    assert a == churn_schedule(7, 8, 2)
+    assert isinstance(a, ChurnSchedule) and a.joiners == 2 and a.churn == 4
+    assert list(a.leavers) == sorted(set(a.leavers))
+    assert all(1 <= p <= 8 for p in a.leavers)
+    assert churn_schedule(8, 8, 2) != a or True  # other seeds legal
+    assert churn_schedule(7, 8, 0) == ChurnSchedule((), 0)
+    with pytest.raises(ValueError):
+        churn_schedule(7, 8, 9)
+    with pytest.raises(ValueError):
+        churn_schedule(7, 8, -1)
+
+
+# ---------------------------------------------------------------------------
+# lint DKG008 + perf_regress EPOCH gate units
+# ---------------------------------------------------------------------------
+
+
+def _load_script(name: str):
+    import pathlib
+    import sys
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "scripts"))
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+def test_lint_dkg008_is_epoch_scoped():
+    import ast
+    import pathlib
+
+    lint_lite = _load_script("lint_lite")
+    src = (
+        "def f(g, pts, p):\n"
+        "    for x in pts:\n"
+        "        g.scalar_mul(2, x)\n"
+        "    open(p, 'wb').write(b'x')\n"
+    )
+    tree = ast.parse(src)
+
+    def codes_for(path: str):
+        return [
+            c
+            for _, c, _ in lint_lite._Checker(
+                pathlib.Path(path), tree, src
+            ).finish()
+        ]
+
+    codes = codes_for("dkg_tpu/epoch/evil.py")
+    assert codes.count("DKG008") == 2, codes  # loop scalar_mul + raw write
+    assert "DKG008" not in codes_for("dkg_tpu/dkg/evil.py")
+
+
+def test_perf_regress_epoch_gate(tmp_path):
+    import json
+
+    perf_regress = _load_script("perf_regress")
+
+    def rnd(i, rate, platform="cpu", curve="ristretto255"):
+        (tmp_path / f"EPOCH_r{i:02d}.json").write_text(
+            json.dumps(
+                {
+                    "bench": "epoch",
+                    "platform": platform,
+                    "curve": curve,
+                    "n": 8,
+                    "t": 3,
+                    "refreshes_per_s": rate,
+                    "reshare_wall_s": 1.0,
+                }
+            )
+        )
+
+    assert perf_regress.main([str(tmp_path)]) == 0  # zero rounds: skip
+    rnd(1, 100.0)
+    assert perf_regress.main([str(tmp_path)]) == 0  # one round: skip
+    rnd(2, 95.0)
+    assert perf_regress.main([str(tmp_path)]) == 0  # 5% dip: within gate
+    rnd(3, 40.0)
+    assert perf_regress.main([str(tmp_path)]) == 1  # 58% drop: trips
+    rnd(4, 40.0, platform="tpu")
+    assert perf_regress.main([str(tmp_path)]) == 0  # shape mismatch: skip
+
+
+# ---------------------------------------------------------------------------
+# slow tier: networked integration + chaos acceptance
+# ---------------------------------------------------------------------------
+
+
+def _run_epoch_sequence(n, t, seed, plan, churn, tmp_path, timeout=600.0):
+    env, keys, pks = make_committee(
+        G, n, t, seed, shared_string=f"epoch-test-{seed}".encode()
+    )
+    chan = InProcessChannel()
+    outs = run_epochs_with_faults(
+        env, keys, pks, plan, lambda i: chan,
+        churn=churn, refreshes=1, timeout=timeout, seed=seed,
+        checkpoint_dir=str(tmp_path),
+    )
+    return env, outs
+
+
+@pytest.mark.slow
+def test_manager_refresh_and_reshare_clean_run(tmp_path):
+    """Fault-free n=4 sequence: one refresh + one 1-leave/1-join
+    reshare.  Every master observed in every epoch is the ceremony's."""
+    n, t, seed = 4, 1, 0xA11CE
+    churn = ChurnSchedule(leavers=(2,), joiners=1)
+    env, outs = _run_epoch_sequence(n, t, seed, FaultPlan(seed), churn, tmp_path)
+    founding, joiners = outs[:n], outs[n:]
+    assert all(o.error is None for o in outs), [o.error for o in outs]
+    masters = {m for o in outs for m in o.masters}
+    base = {G.encode(o.base.master.point) for o in founding}
+    assert len(masters) == 1 and masters == base
+    leaver = founding[1]
+    assert leaver.left and leaver.state is None
+    for o in [founding[0], founding[2], founding[3], *joiners]:
+        assert o.state is not None and o.state.epoch == 2 and o.state.holds_share
+    # the new committee re-agrees on commitments, not just the master
+    encs = {
+        tuple(G.encode(c) for c in o.state.commitments)
+        for o in outs
+        if o.state is not None
+    }
+    assert len(encs) == 1
+
+
+@pytest.mark.slow
+def test_chaos_acceptance_churn_reshare_survives_faults(tmp_path):
+    """ISSUE acceptance: n=8, t=3 -> 2 leave + 2 join under garbage on
+    the refresh deal, equivocation on the reshare deal and one
+    crash-restart of an honest stayer.  The master public key is
+    bit-identical across all epochs, twice, from the same seed."""
+    n, t, seed = 8, 3, 0xC0FFEE
+    churn = ChurnSchedule(leavers=(3, 6), joiners=2)
+
+    def build_plan():
+        return (
+            FaultPlan(seed)
+            .garbage(6, sender=1)  # refresh deal round
+            .equivocate(9, sender=4)  # reshare deal round
+            .restart(sender=2, round_no=7)  # honest stayer, mid-refresh
+        )
+
+    def one_run(run_dir):
+        env, outs = _run_epoch_sequence(
+            n, t, seed, build_plan(), churn, run_dir
+        )
+        founding, joiners = outs[:n], outs[n:]
+        honest = [o for o in founding if o.party not in (1, 4)]
+        assert all(o.error is None for o in honest + joiners), [
+            (o.party, o.error) for o in outs
+        ]
+        base = {G.encode(o.base.master.point) for o in honest if o.base.ok}
+        masters = {m for o in honest + joiners for m in o.masters}
+        assert len(base) == 1 and masters == base
+        for o in honest:
+            if o.party in churn.leavers:
+                assert o.left and o.state is None
+            else:
+                assert o.state is not None and o.state.epoch == 2
+        for o in joiners:
+            assert o.state is not None and o.state.epoch == 2
+        assert founding[1].resumes >= 1  # the restart actually fired
+        return base.pop(), sorted(
+            (o.party, encode_epoch_state(G, o.state))
+            for o in honest + joiners
+            if o.state is not None
+        )
+
+    d1, d2 = tmp_path / "run1", tmp_path / "run2"
+    d1.mkdir(), d2.mkdir()
+    master1, states1 = one_run(d1)
+    master2, states2 = one_run(d2)
+    # seed-reproducible: byte-identical master AND final states
+    assert master1 == master2
+    assert states1 == states2
+
+
+@pytest.mark.slow
+def test_inprocess_epoch_algebra_matches_host_oracle():
+    """The service lane's batched refresh/reshare algebra keeps the
+    secret bit-identical against the poly.host Lagrange oracle, for
+    every (t+1)-subset, across chained operations."""
+    from itertools import combinations
+
+    from dkg_tpu.epoch import inprocess
+    from dkg_tpu.poly import host as ph
+
+    fs = G.scalar_field
+    n, t = 5, 2
+    rng = random.Random(0x0A11)
+    coeffs = [fs.rand_int(rng) for _ in range(t + 1)]
+
+    def horner(x):
+        acc = 0
+        for c in reversed(coeffs):
+            acc = (acc * x + c) % fs.modulus
+        return acc
+
+    secret = coeffs[0]
+    shares = [horner(i) for i in range(1, n + 1)]
+
+    refreshed = inprocess.refresh_shares(fs, n, t, shares, rng)
+    assert refreshed != shares  # every share actually changed
+    for subset in combinations(range(1, n + 1), t + 1):
+        ys = [refreshed[i - 1] for i in subset]
+        assert ph.lagrange_interpolation(fs, 0, ys, list(subset)) == secret
+
+    n2, t2 = 4, 1
+    reshared = inprocess.reshare_shares(fs, n, t, refreshed, n2, t2, rng)
+    assert len(reshared) == n2
+    for subset in combinations(range(1, n2 + 1), t2 + 1):
+        ys = [reshared[i - 1] for i in subset]
+        assert ph.lagrange_interpolation(fs, 0, ys, list(subset)) == secret
+
+    with pytest.raises(ValueError):
+        inprocess.refresh_shares(fs, n, t, shares[:-1], rng)
+    with pytest.raises(ValueError):
+        inprocess.reshare_shares(fs, n, t, shares, 2, 2, rng)  # n' < t'+1
+    with pytest.raises(ValueError):
+        inprocess.reshare_shares(fs, t, t, shares[:t], n2, t2, rng)  # n < t+1
+
+
+@pytest.mark.slow
+def test_scheduler_refresh_and_reshare_hold_the_secret(tmp_path):
+    """Service-lane epoch ops: refresh rotates the held shares in
+    place (epoch CAS advances), reshare mints a new held outcome and
+    retires the source — same secret throughout, public surface
+    unchanged."""
+    import numpy as np
+
+    from dkg_tpu.fields import host as fh
+    from dkg_tpu.poly import host as ph
+    from dkg_tpu.service.engine import CeremonyOutcome
+    from dkg_tpu.service.scheduler import CeremonyScheduler
+
+    fs = G.scalar_field
+    n, t = 5, 2
+    rng = random.Random(0x5EED)
+    coeffs = [fs.rand_int(rng) for _ in range(t + 1)]
+
+    def horner(x):
+        acc = 0
+        for c in reversed(coeffs):
+            acc = (acc * x + c) % fs.modulus
+        return acc
+
+    secret = coeffs[0]
+
+    def held_secret(sch, cid):
+        out = sch.result(cid)
+        shares = [int(v) for v in fh.decode(fs, out.final_shares)]
+        return ph.lagrange_interpolation(
+            fs, 0, shares[: out.t + 1], list(range(1, out.t + 2))
+        )
+
+    sch = CeremonyScheduler(
+        concurrency=1, queue_depth=4, batch_max=1, runtime=object()
+    )
+    try:
+        out = CeremonyOutcome(
+            ceremony_id="epochtest", status="done", curve=G.name, n=n, t=t,
+            master=b"master-bytes", qualified=(True,) * n,
+            final_shares=np.asarray(
+                fh.encode(fs, [horner(i) for i in range(1, n + 1)])
+            ),
+        )
+        with sch._cond:
+            sch._record(out)
+
+        before = out.final_shares.copy()
+        assert sch.refresh("epochtest", seed=7) == 1
+        assert out.epoch == 1 and not np.array_equal(out.final_shares, before)
+        assert held_secret(sch, "epochtest") == secret
+
+        new_cid = sch.reshare("epochtest", 4, 1, seed=8)
+        assert new_cid != "epochtest"
+        new_out = sch.result(new_cid)
+        assert (new_out.n, new_out.t, new_out.epoch) == (4, 1, 2)
+        assert new_out.master == b"master-bytes"
+        assert held_secret(sch, new_cid) == secret
+
+        # the source is retired: results still served, epoch ops refused
+        assert sch.result("epochtest").final_shares is None
+        with pytest.raises(ValueError, match="holds no shares"):
+            sch.refresh("epochtest")
+        with pytest.raises(KeyError):
+            sch.refresh("no-such-ceremony")
+        with pytest.raises(ValueError):
+            sch.reshare(new_cid, 4, 3)  # t'=3 breaks honest majority for n'=4
+    finally:
+        sch.close()
+
+
+@pytest.mark.slow
+def test_refresh_requires_bounded_churn_end_to_end(tmp_path):
+    """max_churn is enforced by the real manager over a real channel:
+    a 1-leave/1-join reshare under max_churn=0 fails CHURN_LIMIT for
+    every party and leaves no party with a new epoch."""
+    n, t, seed = 3, 1, 0xBEEF
+    env, keys, pks = make_committee(G, n, t, seed, shared_string=b"churn-cap")
+    chan = InProcessChannel()
+    from dkg_tpu.net import run_party
+    from dkg_tpu.net.faults import FaultyChannel
+
+    results = [None] * n
+
+    def worker(i):
+        rng = random.Random(seed * 6151 + i)
+        fc = FaultyChannel(chan, FaultPlan(seed), party=i + 1)
+        results[i] = run_party(fc, env, keys[i], pks, i + 1, rng, timeout=600.0)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=900)
+
+    joiner = MemberCommunicationKey.generate(G, random.Random(99)).public()
+    new_pks = [p for i, p in enumerate(pks) if i != 0] + [joiner]
+    errors = []
+
+    def epoch_worker(i):
+        st = genesis_from_party_result(env, results[i])
+        mgr = EpochManager(
+            chan, G, st, keys[i], pks, random.Random(seed + i),
+            timeout=5.0, max_churn=0,
+        )
+        try:
+            mgr.reshare(new_pks, t)
+        except EpochError as e:
+            errors.append(e.kind)
+
+    threads = [threading.Thread(target=epoch_worker, args=(i,)) for i in range(n)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=60)
+    assert errors == ["CHURN_LIMIT"] * n

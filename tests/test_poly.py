@@ -99,3 +99,46 @@ def test_lagrange_at_zero_parity(fs):
         fs, jnp.stack([dxs, dxs]), jnp.stack([dys, dys])
     )
     assert fh.decode_int(fs, np.asarray(got2)[1]) == f.at_zero()
+
+
+# ---------------------------------------------------------------------------
+# duplicate evaluation points: typed rejection, host/device parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("fs", FIELDS, ids=FIELD_IDS)
+def test_host_interpolation_rejects_duplicate_nodes(fs):
+    from dkg_tpu.poly.host import DuplicateEvaluationPoints, check_distinct_nodes
+
+    xs, ys = [2, 5, 2], [1, 2, 3]
+    with pytest.raises(DuplicateEvaluationPoints):
+        lagrange_interpolation(fs, 0, ys, xs)
+    with pytest.raises(DuplicateEvaluationPoints):
+        lagrange_coefficient(fs, 0, 0, xs)
+    with pytest.raises(DuplicateEvaluationPoints):
+        interpolate(fs, xs, ys)
+    # congruent-mod-p nodes are duplicates too
+    with pytest.raises(DuplicateEvaluationPoints):
+        check_distinct_nodes(fs, [3, fs.modulus + 3])
+    check_distinct_nodes(fs, [1, 2, 3])  # distinct: no raise
+    # DuplicateEvaluationPoints is a ValueError: existing broad handlers
+    # (quarantine paths) keep working
+    assert issubclass(DuplicateEvaluationPoints, ValueError)
+
+
+@pytest.mark.parametrize("fs", FIELDS, ids=FIELD_IDS)
+def test_device_lagrange_rejects_duplicate_nodes_eagerly(fs):
+    """Same typed error as the host layer, raised BEFORE any kernel
+    dispatch (concrete inputs only; jitted callers own distinctness)."""
+    from dkg_tpu.poly.host import DuplicateEvaluationPoints
+
+    dup = jnp.asarray(fh.encode(fs, [2, 5, 2]))
+    ys = jnp.asarray(fh.encode(fs, [1, 2, 3]))
+    with pytest.raises(DuplicateEvaluationPoints):
+        pd.lagrange_at_zero_coeffs(fs, dup)
+    with pytest.raises(DuplicateEvaluationPoints):
+        pd.lagrange_at_zero(fs, dup, ys)
+    # a duplicate hiding in ONE row of a batch is still caught
+    ok = jnp.asarray(fh.encode(fs, [2, 5, 7]))
+    with pytest.raises(DuplicateEvaluationPoints):
+        pd.lagrange_at_zero_coeffs(fs, jnp.stack([ok, dup]))
