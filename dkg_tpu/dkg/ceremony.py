@@ -367,7 +367,7 @@ def _point_rlc(cs, weights: jax.Array, points: jax.Array, nbits: int) -> jax.Arr
         if mode == "straus":
             per_col = m * 16 * cs.ncoords * cs.field.limbs * 4
         else:
-            pwin = gd.pippenger_window(m)
+            pwin = gd.pippenger_window(m, cs.name)
             nw = -(-nbits // pwin)
             per_col = nw * (1 << pwin) * cs.ncoords * cs.field.limbs * 4
         for extra in points.shape[2:-2]:

@@ -171,7 +171,9 @@ def _reduce_block(fs: FieldSpec, total8: jax.Array) -> jax.Array:
             [(0, 0)] * (y.ndim - 1) + [(0, max(0, 2 * l + 1 - fw))],
         )
         y = fd.normalize(cols, 2 * l + 1)
-    return fd.barrett_reduce(fs, y[..., : 2 * l])
+    # y is a normalized 2L-limb value: hand it to the per-field reducer
+    # dispatch (fold / linear fold / Barrett — all canonical, bit-exact).
+    return fd.reduce_wide(fs, y[..., : 2 * l])
 
 
 def matmul_mod(fs: FieldSpec, a: jax.Array, b: jax.Array) -> jax.Array:

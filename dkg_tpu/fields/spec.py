@@ -97,6 +97,26 @@ class FieldSpec:
         return int_to_limbs(mu, self.limbs + 1)
 
     @functools.cached_property
+    def linred(self) -> "LinearReduceSpec | None":
+        """Constants for the linear-fold reduction (fields.device.
+        linear_reduce), or ``None`` when the field fails admission.
+
+        Reduction mod p is linear over limb values, so the high half of a
+        2L-limb product folds in one shot: split it into 2L 8-bit digits
+        d_k and precompute D_k = 2**(8k + 16L) mod p — then
+        hi * b**L == sum_k d_k * D_k (mod p), a single (2L x 2L) byte-
+        matrix contraction whose column sums stay inside float32's exact
+        range (<= 2L * 255**2 < 2**22).  The remaining excess over b**L
+        is squeezed out by a few *scan-free* column folds (top spill *
+        c, c = b**L mod p), and the final quotient comes from a tiny
+        precomputed table indexed by the top ~12 bits, leaving exactly
+        one conditional subtraction.  All bounds below are proved with
+        exact Python ints at admission time; inadmissible fields fall
+        back to Barrett.
+        """
+        return _build_linred(self)
+
+    @functools.cached_property
     def fold_limbs(self) -> np.ndarray | None:
         """Pseudo-Mersenne fold constant ``c = b**L mod p`` as limbs, or
         ``None`` when the field is not fold-friendly.
@@ -128,6 +148,98 @@ class FieldSpec:
             x = rng.getrandbits(self.bits)
             if x < self.modulus:
                 return x
+
+
+@dataclasses.dataclass(frozen=True)
+class LinearReduceSpec:
+    """Precomputed constants for ``fields.device.linear_reduce``.
+
+    Every array is a compile-time constant; every bound was verified with
+    exact integer arithmetic in :func:`_build_linred`.
+    """
+
+    fold8: np.ndarray  # (2L, 2L) float32: fold8[k, m] = byte m of D_k
+    c_limbs: np.ndarray  # (L,) uint32: c = b**L mod p
+    n_split: int  # scan-free column-fold iterations
+    shift_e: int  # quotient index = value >> (16*(L-1) + shift_e)
+    qtable: np.ndarray  # (u_max+1,) uint32: floor(u * 2**s / p)
+    np_limbs: np.ndarray  # (L+1,) uint32: b**(L+1) - p  (adds as "-p")
+
+
+def _build_linred(fs: FieldSpec) -> LinearReduceSpec | None:
+    """Derive and *prove* the linear-fold reduction constants.
+
+    The device algorithm (fields.device.linear_reduce) is replayed here
+    over per-column integer upper bounds; any violated invariant makes
+    the field inadmissible (returns None) rather than silently wrong.
+    """
+    L, p, b = fs.limbs, fs.modulus, 1 << LIMB_BITS
+    col_cap = (1 << 32) - (1 << LIMB_BITS)  # normalize()'s input contract
+
+    # Step 1: byte-matrix fold of the high L limbs.
+    d_consts = [(1 << (8 * k + LIMB_BITS * L)) % p for k in range(2 * L)]
+    fold8 = np.zeros((2 * L, 2 * L), np.float32)
+    for k, dk in enumerate(d_consts):
+        for m in range(2 * L):
+            fold8[k, m] = (dk >> (8 * m)) & 0xFF
+    f8i = fold8.astype(np.int64)
+    # exact-float32 guard on the contraction's column sums
+    if int((255 * f8i.sum(axis=0)).max()) >= 1 << 24:
+        return None
+    s16 = [
+        int(255 * f8i[:, 2 * j].sum() + 256 * 255 * f8i[:, 2 * j + 1].sum())
+        for j in range(L)
+    ]
+    colb = [(b - 1) + s for s in s16]  # + low limb of the input
+    if max(colb) > col_cap:
+        return None
+
+    # Step 2: scan-free column folds — top spill times c = b**L mod p.
+    c = (1 << (LIMB_BITS * L)) % p
+    c_l = [int(v) for v in int_to_limbs(c, L)]
+    vb = sum(cb << (LIMB_BITS * j) for j, cb in enumerate(colb))
+    n_split, best = 0, (vb, list(colb))
+    for it in range(1, 65):
+        lob = [min(cb, b - 1) for cb in colb]
+        hib = [cb >> LIMB_BITS for cb in colb]
+        topb = hib[L - 1]
+        colb = [
+            lob[j] + (hib[j - 1] if j else 0) + topb * c_l[j] for j in range(L)
+        ]
+        if max(colb) > col_cap:
+            return None
+        vb = sum(cb << (LIMB_BITS * j) for j, cb in enumerate(colb))
+        if vb >= best[0]:
+            break
+        n_split, best = it, (vb, list(colb))
+    vb = best[0]
+    if vb >= 1 << (LIMB_BITS * (L + 1)):  # must normalize into L+1 limbs
+        return None
+
+    # Step 3/4: quotient-estimate table over the top ~12 bits.  With the
+    # index u = floor(v / 2**s) and 2**s <= p, the true quotient is
+    # qtable[u] or qtable[u] + 1 — one conditional subtraction fixes it.
+    u_full_bits = (vb >> (LIMB_BITS * (L - 1))).bit_length()
+    shift_e = max(0, u_full_bits - 12)
+    s = LIMB_BITS * (L - 1) + shift_e
+    if (1 << s) > p:
+        return None
+    u_max = vb >> s
+    if u_max >= 1 << 13:
+        return None
+    qtable = np.array([(u << s) // p for u in range(u_max + 1)], np.uint32)
+    q_max = vb // p
+    if (b - 1) + q_max * (b - 1) > col_cap:  # step-5 column bound
+        return None
+    np_limbs = int_to_limbs((1 << (LIMB_BITS * (L + 1))) - p, L + 1)
+    return LinearReduceSpec(
+        fold8=fold8,
+        c_limbs=int_to_limbs(c, L),
+        n_split=n_split,
+        shift_e=shift_e,
+        qtable=qtable,
+        np_limbs=np_limbs,
+    )
 
 
 # --------------------------------------------------------------------------

@@ -1152,18 +1152,28 @@ def msm_straus(cs: CurveSpec, scalars: jax.Array, points: jax.Array) -> jax.Arra
     return acc
 
 
-def pippenger_window(m: int) -> int:
-    """Bucket width (bits) from the MSM batch shape.
+# Measured c=4 -> c=8 crossover per curve (CPU probe, jit-cached steady
+# state; msm at m = 64/256/512).  BLS12-381's 24-limb field mul makes
+# every bucket-closing add ~2.3x a 16-limb add, but the scatter pass
+# grows by the same factor, so its crossover sits HIGHER than the
+# 256-bit curves' — w=4 still won at m=256 (704 vs 781 ms) and only
+# loses at m=512 (1483 vs 1292 ms).
+_PIPPENGER_CROSSOVER: dict[str, int] = {"bls12_381_g1": 512}
+
+
+def pippenger_window(m: int, curve: str | None = None) -> int:
+    """Bucket width (bits) from the MSM batch shape (and curve).
 
     Cost model (sequential point-op calls, the CPU/XLA currency):
     NW(c) · (m + 2·(2**c - 1) + c + 1) with NW(c) = 256/c windows — the
     scatter pass is m adds per window regardless of c, the bucket
     suffix-sum closes at 2 adds per bucket, so doubling c halves the
     window count once m dwarfs the 2**(c+1) closing cost.  Crossover
-    c=4 -> c=8 sits at m ≈ 2·(2**8 - 2**4) ≈ 450.  Widths must divide
-    the 16-bit limb (scalar_windows).
+    c=4 -> c=8 sits at m ≈ 2·(2**8 - 2**4) ≈ 450 for the 16-limb
+    curves; measured per-curve overrides in ``_PIPPENGER_CROSSOVER``.
+    Widths must divide the 16-bit limb (scalar_windows).
     """
-    return 8 if m >= 448 else 4
+    return 8 if m >= _PIPPENGER_CROSSOVER.get(curve, 448) else 4
 
 
 def msm_pippenger(
@@ -1203,7 +1213,7 @@ def _msm_pippenger_core(
     """
     m = points.shape[-3]
     batch = points.shape[:-3]
-    window = pippenger_window(m)
+    window = pippenger_window(m, cs.name)
     entries = 1 << window
     nw = min(_n_windows(cs, window), -(-nbits // window))
     digits = scalar_windows(cs, scalars, window)[..., :nw]  # (..., m, nw)

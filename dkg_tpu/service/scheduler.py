@@ -53,7 +53,7 @@ import numpy as np
 from ..epoch import inprocess as epoch_inprocess
 from ..fields import host as fh
 from ..groups import host as gh
-from ..utils import envknobs
+from ..utils import envknobs, obslog
 from ..utils.metrics import REGISTRY
 from . import buckets
 from .durable import ServiceJournal
@@ -387,6 +387,91 @@ class CeremonyScheduler:
             "service_epoch_seconds", time.monotonic() - t0, kind="reshare"
         )
         return new_cid
+
+    def sign(
+        self,
+        cid: str,
+        msgs: list[bytes],
+        *,
+        prove: bool = True,
+        seed: int | None = None,
+    ) -> list[bytes]:
+        """Threshold-sign a whole message batch under ceremony ``cid``:
+        one canonical signature encoding per message.
+
+        The workload the keys are FOR: all B messages hash to the curve
+        in one counter-batched pass (sign.hash2curve), all B x (t+1)
+        partials run as one batched ladder (sign.partial), and the
+        aggregation is one Pippenger MSM with the message batch as a
+        leading axis (sign.aggregate).  With ``prove`` (the default)
+        each partial carries a DLEQ proof and the whole grid is checked
+        in one ``dleq_batch.verify_batch`` pass before aggregation — a
+        corrupted partial raises instead of producing a bad signature.
+
+        Like refresh/reshare this runs on the caller's thread against a
+        snapshot of the held shares; it never mutates the outcome, so
+        concurrent epoch ops are safe (and by share-refresh algebra the
+        signatures they produce are identical).
+        """
+        from .. import sign as signing
+
+        if not msgs:
+            return []
+        t0 = time.monotonic()
+        ts0 = time.time()
+        with self._cond:
+            out = self._held_outcome(cid)
+            fs = gh.ALL_GROUPS[out.curve].scalar_field
+            shares = [int(v) for v in fh.decode(fs, out.final_shares)]
+            qualified = out.qualified
+            curve, t = out.curve, out.t
+        indices = [i + 1 for i, q in enumerate(qualified) if q]
+        if len(indices) < t + 1:
+            raise ValueError(
+                f"ceremony {cid} has {len(indices)} qualified signers, "
+                f"needs t+1={t + 1}"
+            )
+        indices = indices[: t + 1]
+        signer_shares = [shares[i - 1] for i in indices]
+        h_points, _ = signing.hash_to_curve_batch(curve, list(msgs))
+        t_hash = time.monotonic()
+        rng = random.Random(seed) if seed is not None else random.SystemRandom()
+        ps = signing.partial_sign(
+            curve, signer_shares, indices, h_points, rng=rng, prove=prove
+        )
+        if prove:
+            ok = signing.verify_partials(ps)
+            if not ok.all():
+                bad = int((~ok).sum())
+                raise RuntimeError(
+                    f"{bad} partial signature(s) failed DLEQ verification "
+                    f"for ceremony {cid}"
+                )
+        t_partial = time.monotonic()
+        sigs = signing.signature_encode(curve, signing.aggregate(ps))
+        dt = time.monotonic() - t0
+        self.metrics.inc("sign_requests_total", ceremony=cid)
+        self.metrics.inc("sign_messages_total", len(msgs), ceremony=cid)
+        self.metrics.observe("sign_seconds", dt, ceremony=cid)
+        log = obslog.current()
+        if log is not None:
+            log.emit_span(
+                "sign",
+                ts0=ts0,
+                mono0=t0,
+                dur_s=dt,
+                subs={
+                    "hash_s": t_hash - t0,
+                    "partial_s": t_partial - t_hash,
+                    "aggregate_s": time.monotonic() - t_partial,
+                },
+                ceremony=cid,
+                curve=curve,
+                messages=len(msgs),
+                signers=len(indices),
+                proved=prove,
+            )
+        return sigs
 
     # -- worker side --------------------------------------------------------
 

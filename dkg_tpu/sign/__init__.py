@@ -1,0 +1,44 @@
+"""Threshold signing over the DKG'd key: the workload the keys are FOR.
+
+``dkg_tpu/sign/`` turns the repo from "key generation" into a full
+threshold-signature service, pairing-free by construction:
+
+* :mod:`.hash2curve` — message -> curve point H(m): host big-int
+  try-and-increment oracle plus a batch leg that pushes every candidate
+  digest through the array BLAKE2b (``crypto.blake2.blake2b_batch``).
+* :mod:`.partial` — batched partial signatures sig_i = s_i * H(m) for
+  all signers x all messages in ONE device scalar-mul, with per-signer
+  DLEQ proofs (log_g(pk_i) == log_{H(m)}(sig_i)) generated and verified
+  through ``crypto.dleq_batch`` — partial verification needs no
+  pairings.
+* :mod:`.aggregate` — Lagrange aggregation at zero over any t+1 subset,
+  one batched Pippenger MSM across all messages, cross-checked against
+  a host big-int oracle.
+
+Service integration is ``service.scheduler.CeremonyScheduler.sign``.
+Knobs (utils.envknobs, explicit arguments win): ``DKG_TPU_SIGN_BATCH``
+(device message-chunk size), ``DKG_TPU_SIGN_DISPATCH`` (device|host).
+"""
+
+from .aggregate import aggregate, aggregate_host, signature_encode
+from .hash2curve import hash_to_curve_batch, hash_to_curve_host
+from .partial import (
+    PartialSignatures,
+    partial_sign,
+    partial_sign_host,
+    public_keys,
+    verify_partials,
+)
+
+__all__ = [
+    "PartialSignatures",
+    "aggregate",
+    "aggregate_host",
+    "hash_to_curve_batch",
+    "hash_to_curve_host",
+    "partial_sign",
+    "partial_sign_host",
+    "public_keys",
+    "signature_encode",
+    "verify_partials",
+]

@@ -1,0 +1,73 @@
+"""Lagrange aggregation of partial signatures at zero.
+
+A threshold signature over shares s_i on nodes x_i is
+
+    sig(m) = sum_i lambda_i(0) * sig_i(m),   sig_i(m) = s_i * H(m),
+
+because interpolation at zero recovers the master secret in the
+exponent: sum_i lambda_i(0) * s_i = f(0).  The coefficients come from
+the batched device inversion (``poly.device.lagrange_at_zero_coeffs``,
+one Fermat batch-inverse for the whole subset) and the point sum runs
+as ONE Pippenger MSM with the message batch as a leading axis — B
+messages x (t+1) partials in a single bucket pass, the same kernel the
+ceremony's RLC verification uses.
+
+``aggregate_host`` is the big-int oracle (host Lagrange coefficients +
+host MSM) the device leg is pinned against; ``signature_encode``
+produces the canonical wire bytes via ``groups.device.encode_batch``
+(bit-identical to ``HostGroup.encode`` row by row).
+
+Invariance across epochs: refresh/reshare (``dkg_tpu/epoch/``) changes
+the share vector but not f(0), so aggregates from any qualified subset
+of any epoch encode to the same signature bytes (tests/test_sign.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..fields import host as fh
+from ..groups import device as gd
+from ..groups import host as gh
+from ..poly import device as pd
+from ..poly import host as ph
+from .partial import PartialSignatures
+
+
+def aggregate(ps: PartialSignatures, subset: list[int] | None = None) -> np.ndarray:
+    """Aggregate a t+1 subset of partials into full signatures.
+
+    ``subset``: positions into ``ps.indices`` (default: all signers the
+    batch carries).  Returns ``(B, C, L)`` canonical affine limbs — the
+    same currency as the partials, ready for :func:`signature_encode`.
+    """
+    cs = gd.ALL_CURVES[ps.curve]
+    pos = list(range(len(ps.indices))) if subset is None else list(subset)
+    xs = [ps.indices[p] for p in pos]
+    sigs = jnp.asarray(ps.sigs[:, pos])  # (B, M, C, L)
+    xs_limbs = jnp.asarray(fh.encode(cs.scalar, xs))  # (M, L)
+    lam = pd.lagrange_at_zero_coeffs(cs.scalar, xs_limbs)  # (M, L)
+    agg = gd.msm_pippenger(cs, lam, sigs)  # (B, C, L)
+    return gd.affine_canon_host(cs, np.asarray(agg))
+
+
+def aggregate_host(
+    group: gh.HostGroup, indices: list[int], sig_rows: list[list]
+) -> list:
+    """Big-int oracle: per-message Lagrange-weighted host MSM over the
+    subset's partials.  ``sig_rows``: [message][signer] host tuples in
+    ``indices`` order.  Compare to the device leg via ``group.encode``.
+    """
+    fs = group.scalar_field
+    xs = [i % fs.modulus for i in indices]
+    lams = [ph.lagrange_coefficient(fs, 0, i, xs) for i in range(len(xs))]
+    return [group.msm(lams, row) for row in sig_rows]
+
+
+def signature_encode(curve: str, sigs: np.ndarray) -> list[bytes]:
+    """Canonical signature wire bytes for a ``(B, C, L)`` aggregate
+    batch, bit-identical to ``HostGroup.encode`` per row."""
+    enc = gd.encode_batch(gd.ALL_CURVES[curve], np.asarray(sigs))
+    return [row.tobytes() for row in np.asarray(enc)]

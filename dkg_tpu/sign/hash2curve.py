@@ -1,0 +1,104 @@
+"""Hash-to-curve for message digests: H(m) as a group element.
+
+Two bit-identical legs, same split as the transcript digest and the DEM
+(host byte-plumbing, arrays for the wide work):
+
+* :func:`hash_to_curve_host` — the per-message oracle, delegating to
+  ``HostGroup.hash_to_group`` (try-and-increment with cofactor
+  clearing; variable-time, but H(m) is public by definition).
+* :func:`hash_to_curve_batch` — the batch leg: every candidate digest
+  for a whole *block of counters x all pending messages* runs through
+  ``crypto.blake2.blake2b_batch`` as ONE array call (the per-candidate
+  cost that remains host-side is the quadratic-residue lift, which is a
+  couple of big-int pows).  Candidates are consumed in the exact
+  counter order of the host loop, so the selected points — and the
+  device-canonical limb tensor built from them — are bit-identical to
+  the oracle's.
+
+The Weierstrass curves (secp256k1, BLS12-381 G1) take the batched
+counter search; Ristretto's one-shot elligator map has no search to
+batch and routes through the oracle per message.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from ..crypto.blake2 import blake2b_batch
+from ..groups import device as gd
+from ..groups import host as gh
+from ..groups.host import _person
+
+#: Domain tag for signing digests; distinct from the commitment-key
+#: domain so H(m) can never collide with a ceremony generator.
+SIGN_DOMAIN = b"dkg_tpu.sign.h2c"
+
+#: Counters hashed per batched round; P(no quadratic residue in a
+#: round) ~= 2**-8 per message, so one round nearly always suffices.
+_CTR_BLOCK = 8
+
+
+def hash_to_curve_host(group: gh.HostGroup, msg: bytes, domain: bytes = SIGN_DOMAIN):
+    """Host big-int oracle: H(msg) as a host point tuple."""
+    return group.hash_to_group(msg, domain)
+
+
+def _batch_weierstrass(group, msgs, domain):
+    """Counter-batched try-and-increment, bit-identical to the oracle."""
+    nb = group.base_field.nbytes + 16
+    person = _person(domain)
+    found: list = [None] * len(msgs)
+    # equal-length rows per blake2b_batch call: bucket by message length
+    by_len: dict[int, list[int]] = {}
+    for i, m in enumerate(msgs):
+        by_len.setdefault(len(m), []).append(i)
+    for mlen, idxs in by_len.items():
+        pending = list(idxs)
+        ctr0 = 0
+        while pending:
+            rows = np.zeros((len(pending) * _CTR_BLOCK, mlen + 4), np.uint8)
+            for r, i in enumerate(pending):
+                body = np.frombuffer(msgs[i], dtype=np.uint8)
+                for k in range(_CTR_BLOCK):
+                    rows[r * _CTR_BLOCK + k, :mlen] = body
+                    rows[r * _CTR_BLOCK + k, mlen:] = np.frombuffer(
+                        (ctr0 + k).to_bytes(4, "little"), dtype=np.uint8
+                    )
+            digests = blake2b_batch(rows, digest_size=nb, person=person)
+            still = []
+            for r, i in enumerate(pending):
+                for k in range(_CTR_BLOCK):
+                    h = digests[r * _CTR_BLOCK + k].tobytes()
+                    x = int.from_bytes(h, "little") % group.prime
+                    y = group._lift_x(x, 0)
+                    if y is None:
+                        continue
+                    pt = group._mul_int(group.cofactor, (x, y, 1))
+                    if group.eq(pt, group.identity()):
+                        continue
+                    found[i] = pt
+                    break
+                else:
+                    still.append(i)
+            pending = still
+            ctr0 += _CTR_BLOCK
+    return found
+
+
+def hash_to_curve_batch(
+    curve: str, msgs: list[bytes], domain: bytes = SIGN_DOMAIN
+) -> tuple[list, jax.Array]:
+    """H(m) for a whole message batch: (host point tuples, device
+    ``(B, C, L)`` canonical affine limbs), bit-identical to calling
+    :func:`hash_to_curve_host` per message."""
+    cs = gd.ALL_CURVES[curve]
+    group = gh.ALL_GROUPS[curve]
+    if isinstance(group, gh.WeierstrassGroup):
+        pts = _batch_weierstrass(group, msgs, domain)
+    else:
+        pts = [group.hash_to_group(m, domain) for m in msgs]
+    # canonical affine limbs (bit-identical to the device affine pass)
+    dev = gd.affine_canon_host(cs, np.asarray(gd.from_host(cs, pts)))
+    return pts, jax.numpy.asarray(dev)

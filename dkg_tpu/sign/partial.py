@@ -1,0 +1,195 @@
+"""Batched partial signatures: every signer x every message at once.
+
+A partial signature is ``sig_i = s_i * H(m)`` — per (message, signer)
+pair one scalar multiplication.  The reference shape would be a double
+loop; here the whole ``(B messages, m signers)`` grid is ONE batched
+device ladder call (scalars broadcast along the message axis, bases
+along the signer axis), chunked over messages only to bound live
+memory (``DKG_TPU_SIGN_BATCH``).  Public keys ``pk_i = s_i * g`` ride
+the persistent fixed-base comb tables (``groups.precompute``).
+
+Partial verification is pairing-free: each signer proves
+``log_g(pk_i) == log_{H(m)}(sig_i)`` with a DLEQ proof, and a verifier
+checks the whole grid in ONE ``crypto.dleq_batch.verify_batch`` pass
+(one batched m=2 MSM + host Fiat-Shamir digests).
+
+``partial_sign_host`` is the per-share big-int oracle the device leg is
+pinned against (tests/test_sign.py); it is the allowlisted exception to
+lint rule DKG009 (no per-message scalar_mul loops in sign/ hot paths).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..crypto import dleq_batch
+from ..crypto.dleq import DleqZkp
+from ..fields import host as fh
+from ..groups import device as gd
+from ..groups import host as gh
+from ..groups import precompute
+from ..utils import envknobs
+
+
+def _sign_chunk(chunk: int | None) -> int:
+    """Device message-chunk size: explicit argument wins, then the
+    validated DKG_TPU_SIGN_BATCH knob, then 256 (a (256, t+1) lane grid
+    keeps the 381-bit ladder's live set comfortably in memory)."""
+    if chunk is not None:
+        if chunk < 1:
+            raise ValueError(f"sign chunk must be positive, got {chunk}")
+        return chunk
+    return envknobs.pos_int("DKG_TPU_SIGN_BATCH", "sign message-chunk size") or 256
+
+
+def _sign_dispatch(dispatch: str | None) -> str:
+    """device|host: explicit argument wins, then DKG_TPU_SIGN_DISPATCH."""
+    if dispatch is not None:
+        if dispatch not in ("device", "host"):
+            raise ValueError(f"sign dispatch must be device|host, got {dispatch!r}")
+        return dispatch
+    return (
+        envknobs.choice(
+            "DKG_TPU_SIGN_DISPATCH", ("device", "host"), "partial-sign leg"
+        )
+        or "device"
+    )
+
+
+@dataclasses.dataclass
+class PartialSignatures:
+    """One batch of partial signatures over a signer subset.
+
+    ``sigs`` holds canonical affine limbs (``(B, m, C, L)`` uint32) so
+    downstream aggregation/encoding never re-canonicalises; host point
+    tuples for the DLEQ transcripts are derived lazily.
+    """
+
+    curve: str
+    indices: tuple[int, ...]  # 1-based signer indices, len m
+    h_points: list  # host H(m) tuples, len B
+    sigs: np.ndarray  # (B, m, C, L) canonical affine limbs
+    pks: list  # host pk_i tuples, len m
+    proofs: list[DleqZkp] | None = None  # row-major over (B, m)
+
+    def sigs_host(self) -> list[list[tuple]]:
+        """Host point tuples, [message][signer]."""
+        b, m = self.sigs.shape[:2]
+        flat = gd.to_host(
+            gd.ALL_CURVES[self.curve], self.sigs.reshape(b * m, *self.sigs.shape[2:])
+        )
+        return [flat[i * m : (i + 1) * m] for i in range(b)]
+
+
+def public_keys(curve: str, shares: list[int]) -> tuple[np.ndarray, list]:
+    """pk_i = s_i * g for every share, through the persistent comb
+    tables: (canonical affine limbs (m, C, L), host tuples)."""
+    cs = gd.ALL_CURVES[curve]
+    table = precompute.generator_table(cs)
+    k = jnp.asarray(fh.encode(cs.scalar, shares))
+    pts = gd.fixed_base_mul(cs, table, k)
+    canon = gd.affine_canon_host(cs, np.asarray(pts))
+    return canon, gd.to_host(cs, canon)
+
+
+def partial_sign_host(group: gh.HostGroup, shares: list[int], h_point) -> list[tuple]:
+    """Per-share big-int oracle: [s_i * H(m)] as host point tuples
+    (projective; compare via ``group.encode``).  The bit-exactness
+    reference for the batched device leg (and the DKG009 allowlisted
+    host path)."""
+    return [group.scalar_mul_vartime(s, h_point) for s in shares]
+
+
+def partial_sign(
+    curve: str,
+    shares: list[int],
+    indices: list[int],
+    h_points: list,
+    *,
+    rng=None,
+    prove: bool = False,
+    dispatch: str | None = None,
+    chunk: int | None = None,
+) -> PartialSignatures:
+    """Sign every message with every share: ``(B, m)`` partials.
+
+    ``h_points``: host H(m) tuples (from hash2curve).  ``prove=True``
+    attaches per-(message, signer) DLEQ proofs (requires ``rng``).  The
+    device leg runs the whole grid as one broadcast ladder per message
+    chunk; the host leg is the oracle loop (cross-checks, tiny batches).
+    """
+    if len(shares) != len(indices):
+        raise ValueError("shares and indices must pair up")
+    if prove and rng is None:
+        raise ValueError("prove=True requires rng")
+    cs = gd.ALL_CURVES[curve]
+    group = gh.ALL_GROUPS[curve]
+    mode = _sign_dispatch(dispatch)
+    b, m = len(h_points), len(shares)
+    if mode == "host":
+        rows = [partial_sign_host(group, shares, h) for h in h_points]
+        flat = gd.from_host(cs, [p for row in rows for p in row])
+        sigs = gd.affine_canon_host(
+            cs, np.asarray(flat).reshape(b, m, cs.ncoords, cs.field.limbs)
+        )
+    else:
+        k = jnp.asarray(fh.encode(cs.scalar, shares))  # (m, L)
+        h_dev = gd.from_host(cs, h_points)  # (B, C, L)
+        csize = _sign_chunk(chunk)
+        parts = []
+        for b0 in range(0, b, csize):
+            blk = h_dev[b0 : b0 + csize]
+            bc = blk.shape[0]
+            # (B', m) lanes in ONE ladder: scalars broadcast over
+            # messages, bases over signers — no per-message loop.
+            kk = jnp.broadcast_to(k[None, :, :], (bc, m, k.shape[-1]))
+            pp = jnp.broadcast_to(blk[:, None, :, :], (bc, m) + blk.shape[-2:])
+            # noqa-rationale: each call covers a whole (B', m) grid —
+            # the loop is DKG_TPU_SIGN_BATCH memory chunking over
+            # messages, not a per-message mult.
+            out = gd.scalar_mul(cs, kk, pp)  # noqa: DKG009
+            parts.append(np.asarray(out))
+        sigs = gd.affine_canon_host(cs, np.concatenate(parts, axis=0))
+    pks_canon, pks = public_keys(curve, shares)
+    ps = PartialSignatures(
+        curve=curve,
+        indices=tuple(int(i) for i in indices),
+        h_points=list(h_points),
+        sigs=sigs,
+        pks=pks,
+    )
+    if prove:
+        g = group.generator()
+        statements = []
+        sigs_host = ps.sigs_host()
+        for bi in range(b):
+            for si in range(m):
+                statements.append(
+                    (g, h_points[bi], pks[si], sigs_host[bi][si], shares[si])
+                )
+        ps.proofs = dleq_batch.generate_batch(group, cs, statements, rng)
+    return ps
+
+
+def verify_partials(ps: PartialSignatures) -> np.ndarray:
+    """Check every partial's DLEQ proof in ONE batched pass ->
+    ``(B, m)`` bool.  Pairing-free: a valid proof pins
+    log_{H(m)}(sig_i) to log_g(pk_i), which is s_i by the ceremony's
+    public commitments."""
+    if ps.proofs is None:
+        raise ValueError("PartialSignatures carries no proofs (prove=False)")
+    cs = gd.ALL_CURVES[ps.curve]
+    group = gh.ALL_GROUPS[ps.curve]
+    g = group.generator()
+    b, m = ps.sigs.shape[:2]
+    sigs_host = ps.sigs_host()
+    statements = []
+    for bi in range(b):
+        for si in range(m):
+            statements.append((g, ps.h_points[bi], ps.pks[si], sigs_host[bi][si]))
+    ok = dleq_batch.verify_batch(group, cs, ps.proofs, statements)
+    return np.asarray(ok).reshape(b, m)

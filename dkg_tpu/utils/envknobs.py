@@ -8,9 +8,16 @@ dkg.ceremony._env_chunk, DKG_TPU_DEM / DKG_TPU_DEM_CHUNK via
 dkg.hybrid_batch, DKG_TPU_RLC via dkg.ceremony._point_rlc,
 DKG_TPU_MSM / DKG_TPU_FB_WINDOW / DKG_TPU_FUSED_MULTI /
 DKG_TPU_ED_FUSED_LADDER / DKG_TPU_ED_FUSED_DOUBLES via groups.device,
-DKG_TPU_PALLAS / DKG_TPU_ASSUME_BACKEND via fields.device,
+DKG_TPU_PALLAS / DKG_TPU_ASSUME_BACKEND / DKG_TPU_REDUCE
+(fold|linear|barrett — force a wide-reduction algorithm; inadmissible
+choices raise at trace time) / DKG_TPU_CARRY (scan|lookahead carry
+propagation in normalize) via fields.device,
 DKG_TPU_MXU via fields.matmul, DKG_TPU_TABLE_CACHE via
 groups.precompute, DKG_TPU_NET_* transport knobs via net.channel,
+DKG_TPU_SIGN_BATCH (device message-chunk size) and
+DKG_TPU_SIGN_DISPATCH (device|host partial-signature leg) via
+sign.partial — lint rule DKG009 bans raw environment access and
+per-message scalar-mul loops in dkg_tpu/sign/ hot paths,
 DKG_TPU_CHECKPOINT_DIR via net.checkpoint,
 DKG_TPU_DIGEST via crypto.device_hash.digest_dispatch,
 DKG_TPU_OBSLOG flight-recorder log directory via utils.obslog,

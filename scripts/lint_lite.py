@@ -72,6 +72,16 @@ linter), so the committed baseline stays clean between CI runs:
         write: epoch state (it contains shares) persists ONLY through
         the party WAL (``net.checkpoint.PartyWal`` epoch records;
         docs/resharing.md)
+* DKG009  (dkg_tpu/sign/ only) per-message scalar work or raw
+        configuration in signing code: a ``scalar_mul``/
+        ``scalar_mul_vartime`` call lexically inside a loop — partial
+        signing and aggregation must run as ONE batched device call
+        (broadcast ladder / Pippenger MSM) so B messages x t+1 signers
+        cost one dispatch, not B·(t+1) host mults; the ``*_host``
+        big-int oracle legs the device paths are pinned against are
+        allowlisted by name suffix — or a raw ``os.environ`` /
+        ``os.getenv`` read: signing knobs (DKG_TPU_SIGN_*) go through
+        ``utils.envknobs`` (docs/signing.md)
 
 Exit 0 = clean.  Run: ``python scripts/lint_lite.py`` (from repo root).
 Also executed by tests/test_import_hygiene.py so the default test tier
@@ -167,6 +177,13 @@ _SERVICE_SPAWN_OWNER = "scheduler.py"
 # (Batched gd.scalar_mul over stacked rows sits OUTSIDE any loop.)
 _EPOCH_SCALAR_MULS = {"scalar_mul", "scalar_mul_vartime"}
 
+# The same entry points banned inside loops in dkg_tpu/sign/ (DKG009):
+# a host scalar_mul per (message, signer) pair is the B·(t+1) pathology
+# the broadcast ladder and the batched MSM exist to avoid.  Functions
+# whose name ends in ``_host`` are the allowlisted big-int oracle legs
+# (bit-exactness references, never hot paths).
+_SIGN_HOST_ORACLE_SUFFIX = "_host"
+
 
 class _Checker(ast.NodeVisitor):
     def __init__(self, path: pathlib.Path, tree: ast.Module, source: str):
@@ -183,6 +200,7 @@ class _Checker(ast.NodeVisitor):
         self._pkg_module = "dkg_tpu/" in path.as_posix()
         self._service_module = "dkg_tpu/service/" in path.as_posix()
         self._epoch_module = "dkg_tpu/epoch/" in path.as_posix()
+        self._sign_module = "dkg_tpu/sign/" in path.as_posix()
         self._dem_hot_module = (
             self._dkg_module and path.name in _DEM_HOT_MODULES
         )
@@ -228,6 +246,21 @@ class _Checker(ast.NodeVisitor):
                 node,
                 "DKG007",
                 "os.environ in dkg_tpu/service/ — read knobs through "
+                "utils.envknobs so bad values fail loudly and every knob "
+                "is documented",
+            )
+        # DKG009a: same ownership rule for signing code — DKG_TPU_SIGN_*
+        # knobs are validated and documented in utils.envknobs.
+        if (
+            self._sign_module
+            and node.attr == "environ"
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "os"
+        ):
+            self._add(
+                node,
+                "DKG009",
+                "os.environ in dkg_tpu/sign/ — read knobs through "
                 "utils.envknobs so bad values fail loudly and every knob "
                 "is documented",
             )
@@ -516,6 +549,40 @@ class _Checker(ast.NodeVisitor):
                     f"raw file write ({wname}) in dkg_tpu/epoch/ — epoch "
                     "state persists only through net.checkpoint.PartyWal "
                     "epoch records",
+                )
+        # DKG009b: signing hot paths must stay batched — one broadcast
+        # ladder for all (message, signer) partials, one Pippenger MSM
+        # for aggregation.  A scalar_mul inside a loop is the B·(t+1)
+        # host pathology; the *_host oracle legs are the one exception.
+        # os.getenv likewise bypasses envknobs' validation.
+        if self._sign_module:
+            func = node.func
+            name = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else ""
+            )
+            if name == "getenv":
+                self._add(
+                    node,
+                    "DKG009",
+                    "os.getenv() in dkg_tpu/sign/ — read knobs through "
+                    "utils.envknobs so bad values fail loudly and every "
+                    "knob is documented",
+                )
+            if (
+                name in _EPOCH_SCALAR_MULS
+                and self._loop_depth > 0
+                and not any(
+                    f.endswith(_SIGN_HOST_ORACLE_SUFFIX)
+                    for f in self._func_stack
+                )
+            ):
+                self._add(
+                    node,
+                    "DKG009",
+                    f"{name}() inside a loop in dkg_tpu/sign/ — partials "
+                    "and aggregation run as ONE batched call "
+                    "(gd.scalar_mul over the (B, t+1) grid / "
+                    "gd.msm_pippenger); *_host oracle legs only",
                 )
         # DKG004b: a hashlib.blake2b call lexically inside a loop in a
         # batch hot module is a per-dealer host hash loop — use

@@ -46,6 +46,14 @@ is reported but informational: a single op's wall time on a shared box
 is too noisy to gate).  Mismatched platforms or committee shapes
 (n/t/curve) skip with a note.
 
+The signing subsystem: ``SIGN_r{NN}.json`` rounds
+(scripts/sign_bench.py) are diffed newest-two, per (curve, n, messages)
+shape — FAIL when a shape's ``partials_per_s`` dropped more than the
+threshold (proof and aggregate rates are informational: they carry
+host-side Fiat-Shamir hashing and single-dispatch MSM noise).  Shapes
+present in only one round, or rounds from different platforms, skip
+with a note.
+
 Run: ``python scripts/perf_regress.py [--threshold 0.2] [dir]``.
 """
 
@@ -60,6 +68,7 @@ import sys
 _PAT = re.compile(r"BENCH_r(\d+)\.json$")
 _FLEET_PAT = re.compile(r"FLEET_r(\d+)\.json$")
 _EPOCH_PAT = re.compile(r"EPOCH_r(\d+)\.json$")
+_SIGN_PAT = re.compile(r"SIGN_r(\d+)\.json$")
 
 
 def _load_rounds(root: pathlib.Path) -> list[tuple[int, dict]]:
@@ -101,8 +110,10 @@ def main(argv: list[str] | None = None) -> int:
         else pathlib.Path(__file__).resolve().parent.parent
     )
 
-    fleet_bad = fleet_gate(root, args.threshold) or epoch_gate(
-        root, args.threshold
+    fleet_bad = (
+        fleet_gate(root, args.threshold)
+        or epoch_gate(root, args.threshold)
+        or sign_gate(root, args.threshold)
     )
 
     rounds = _load_rounds(root)
@@ -322,6 +333,95 @@ def epoch_gate(root: pathlib.Path, threshold: float) -> int:
         print(
             f"perf_regress: epoch reshare_wall_s r{old_n} {rw_old:.3f} -> "
             f"r{new_n} {rw_new:.3f} s — informational, not gated"
+        )
+    return bad
+
+
+def _load_sign_rounds(root: pathlib.Path) -> list[tuple[int, dict]]:
+    """(round number, sign report) for every usable signing round,
+    ascending — usable means at least one correct shape with a positive
+    partial rate."""
+    out: list[tuple[int, dict]] = []
+    for path in sorted(root.glob("SIGN_r*.json")):
+        m = _SIGN_PAT.search(path.name)
+        if not m:
+            continue
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError):
+            continue
+        shapes = doc.get("shapes") if isinstance(doc, dict) else None
+        if not isinstance(shapes, list):
+            continue
+        usable = [
+            s
+            for s in shapes
+            if isinstance(s, dict)
+            and s.get("correct")
+            and isinstance(s.get("partials_per_s"), (int, float))
+            and s["partials_per_s"] > 0
+        ]
+        if not usable:
+            continue
+        out.append((int(m.group(1)), doc))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+def sign_gate(root: pathlib.Path, threshold: float) -> int:
+    """Diff the newest two signing rounds per (curve, n, messages)
+    shape: ``partials_per_s`` must not DROP beyond the threshold.
+    Proof/aggregate rates print but never gate."""
+    rounds = _load_sign_rounds(root)
+    if len(rounds) < 2:
+        print(
+            f"perf_regress: {len(rounds)} usable sign round(s) in {root} "
+            "— nothing to diff"
+        )
+        return 0
+    (old_n, old), (new_n, new) = rounds[-2], rounds[-1]
+    if old.get("platform") != new.get("platform"):
+        print(
+            f"perf_regress: sign r{old_n} ({old.get('platform')}) vs "
+            f"r{new_n} ({new.get('platform')}) ran on different platforms "
+            "— incomparable, skipping"
+        )
+        return 0
+
+    def by_shape(doc: dict) -> dict:
+        return {
+            (s.get("curve"), s.get("n"), s.get("messages")): s
+            for s in doc.get("shapes", [])
+            if isinstance(s, dict) and s.get("correct")
+        }
+
+    olds, news = by_shape(old), by_shape(new)
+    bad = 0
+    matched = False
+    for key in sorted(olds.keys() & news.keys(), key=str):
+        old_v = olds[key].get("partials_per_s")
+        new_v = news[key].get("partials_per_s")
+        if not (
+            isinstance(old_v, (int, float)) and old_v > 0
+            and isinstance(new_v, (int, float)) and new_v > 0
+        ):
+            continue
+        matched = True
+        change = (new_v - old_v) / old_v
+        curve, n, b = key
+        line = (
+            f"perf_regress: sign {curve} n={n} B={b} partials_per_s "
+            f"r{old_n} {old_v:.1f} -> r{new_n} {new_v:.1f} ({change:+.1%})"
+        )
+        if change < -threshold:
+            print(f"{line} — REGRESSION beyond {threshold:.0%}", file=sys.stderr)
+            bad = 1
+        else:
+            print(line)
+    if not matched:
+        print(
+            f"perf_regress: sign r{old_n} and r{new_n} share no usable "
+            "shapes — nothing to diff"
         )
     return bad
 

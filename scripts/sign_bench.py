@@ -1,0 +1,182 @@
+"""Threshold-signing benchmark: partial-sign / verify / aggregate rates.
+
+Measures the three stages of :mod:`dkg_tpu.sign` against a seeded
+Shamir sharing (no ceremony — the bench isolates signing cost), per
+curve and committee shape:
+
+* ``partials_per_s`` — batched partial signatures through the one
+  broadcast ladder (``sign.partial.partial_sign``), counted as B
+  messages x (t+1) signers lanes per wall-second;
+* ``proofs_per_s`` — DLEQ generation + the one-pass batch verification
+  (``verify_partials``) over the same grid;
+* ``signatures_per_s`` — Lagrange aggregation (one Pippenger MSM with
+  the message batch leading) plus canonical encoding.
+
+Every run first CHECKS the math: the aggregate of the first message
+must equal ``secret * H(m)`` by the host big-int oracle — the bench
+fails loudly rather than publish rates for wrong signatures.
+
+Writes one JSON report (default ``SIGN_r01.json``);
+``scripts/perf_regress.py`` diffs the newest two rounds per
+(curve, n, messages) shape and fails on a >20% ``partials_per_s`` drop
+(verify and aggregate rates are informational — they carry host-side
+Fiat-Shamir hashing and single-dispatch MSM noise).
+
+Run (CPU):
+    JAX_PLATFORMS=cpu python scripts/sign_bench.py --out SIGN_r01.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import random
+import sys
+import time
+
+if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR", "/tmp/dkg_tpu_jax_cache_cputest"
+    )
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import jax  # noqa: E402
+
+if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+    jax.config.update(
+        "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"]
+    )
+
+from dkg_tpu import sign as signing  # noqa: E402
+from dkg_tpu.groups import host as gh  # noqa: E402
+from dkg_tpu.utils.metrics import REGISTRY  # noqa: E402
+
+
+def base_sharing(fs, n: int, t: int, rng) -> tuple[int, list[int]]:
+    """A seeded (n, t) Shamir sharing: (secret, shares at 1..n)."""
+    coeffs = [fs.rand_int(rng) for _ in range(t + 1)]
+
+    def at(x: int) -> int:
+        acc = 0
+        for c in reversed(coeffs):
+            acc = (acc * x + c) % fs.modulus
+        return acc
+
+    return coeffs[0], [at(i) for i in range(1, n + 1)]
+
+
+def bench_shape(curve: str, n: int, t: int, messages: int, seed: int) -> dict:
+    group = gh.ALL_GROUPS[curve]
+    fs = group.scalar_field
+    rng = random.Random(seed)
+    secret, shares = base_sharing(fs, n, t, rng)
+    indices = list(range(1, t + 2))
+    signer_shares = shares[: t + 1]
+    msgs = [f"sign-bench|{curve}|{n}|{i}".encode() for i in range(messages)]
+
+    # warmup: compile the ladder/MSM shapes (persisted in the JAX cache)
+    h_warm, _ = signing.hash_to_curve_batch(curve, msgs[:1])
+    ps_warm = signing.partial_sign(
+        curve, signer_shares, indices, h_warm, rng=rng, prove=True
+    )
+    signing.verify_partials(ps_warm)
+    signing.aggregate(ps_warm)
+
+    t0 = time.perf_counter()
+    h_points, _ = signing.hash_to_curve_batch(curve, msgs)
+    hash_wall = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    ps = signing.partial_sign(curve, signer_shares, indices, h_points)
+    partial_wall = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    ps = signing.partial_sign(
+        curve, signer_shares, indices, h_points, rng=rng, prove=True
+    )
+    ok = signing.verify_partials(ps)
+    verify_wall = time.perf_counter() - t0
+    correct = bool(ok.all())
+
+    t0 = time.perf_counter()
+    sigs = signing.signature_encode(curve, signing.aggregate(ps))
+    agg_wall = time.perf_counter() - t0
+
+    # the oracle check: sig_0 == secret * H(m_0), host big ints
+    correct &= sigs[0] == group.encode(
+        group.scalar_mul_vartime(secret, h_points[0])
+    )
+
+    lanes = messages * (t + 1)
+    return {
+        "curve": curve,
+        "n": n,
+        "t": t,
+        "messages": messages,
+        "signers": t + 1,
+        "hash_wall_s": round(hash_wall, 3),
+        "partial_wall_s": round(partial_wall, 3),
+        "partials_per_s": round(lanes / partial_wall, 1),
+        "verify_wall_s": round(verify_wall, 3),
+        "proofs_per_s": round(lanes / verify_wall, 1),
+        "aggregate_wall_s": round(agg_wall, 3),
+        "signatures_per_s": round(messages / agg_wall, 1),
+        "correct": correct,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--curves", default="secp256k1,bls12_381_g1",
+        help="comma-separated device curve names",
+    )
+    ap.add_argument(
+        "--shapes", default="64,256",
+        help="comma-separated committee sizes (t = (n-1)//3)",
+    )
+    ap.add_argument("--messages", type=int, default=16)
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--out", default="SIGN_r01.json")
+    args = ap.parse_args(argv)
+
+    shapes = []
+    ok = True
+    for curve in args.curves.split(","):
+        for n in (int(v) for v in args.shapes.split(",")):
+            t = (n - 1) // 3
+            print(
+                f"sign_bench: {curve} n={n} t={t} B={args.messages} "
+                f"on {jax.default_backend()}",
+                flush=True,
+            )
+            shape = bench_shape(curve, n, t, args.messages, args.seed)
+            ok &= shape["correct"]
+            print(
+                f"sign_bench: {shape['partials_per_s']} partials/s, "
+                f"{shape['proofs_per_s']} proofs/s, "
+                f"{shape['signatures_per_s']} signatures/s, "
+                f"correct={shape['correct']}",
+                flush=True,
+            )
+            shapes.append(shape)
+
+    report = {
+        "bench": "sign",
+        "platform": jax.default_backend(),
+        "nproc": os.cpu_count(),
+        "messages": args.messages,
+        "seed": args.seed,
+        "shapes": shapes,
+        "metrics": REGISTRY.snapshot(),
+    }
+    pathlib.Path(args.out).write_text(json.dumps(report, indent=1) + "\n")
+    print(f"sign_bench: wrote {args.out}", flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -115,8 +115,16 @@ def test_msm_knob_rejects_typos(monkeypatch):
 def test_pippenger_window_heuristic_crossover():
     """Bucket width follows the cost model in docs/perf.md: narrow
     windows for small batches, 8-bit once the scatter pass dominates
-    the bucket-closing cost (crossover ~450 points)."""
+    the bucket-closing cost (crossover ~450 points; measured per-curve
+    overrides shift BLS12-381 to 512)."""
     assert gd.pippenger_window(2) == 4
     assert gd.pippenger_window(447) == 4
     assert gd.pippenger_window(448) == 8
     assert gd.pippenger_window(4096) == 8
+    # per-curve measured crossovers: BLS12-381 stays narrow longer
+    assert gd.pippenger_window(448, "bls12_381_g1") == 4
+    assert gd.pippenger_window(511, "bls12_381_g1") == 4
+    assert gd.pippenger_window(512, "bls12_381_g1") == 8
+    # curves without an override follow the model's default
+    assert gd.pippenger_window(448, "secp256k1") == 8
+    assert gd.pippenger_window(448, "ristretto255") == 8

@@ -126,7 +126,12 @@ def test_concurrent_warmers_build_exactly_once(table_cache):
     )
 
 
+@pytest.mark.slow
 def test_ceremony_master_key_identical_cached_vs_fresh(table_cache):
+    """Three full secp256k1 engine runs (fresh build, warm process
+    cache, disk reload) — ~2 min of compile on the 1-core box, so it
+    rides the slow tier; the cache plumbing itself is covered at the
+    table level by the default-tier tests above."""
     from dkg_tpu.dkg import ceremony as ce
 
     def run_ceremony():

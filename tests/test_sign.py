@@ -1,0 +1,432 @@
+"""Threshold signing (dkg_tpu.sign): partials, DLEQ verification,
+Lagrange aggregation, epoch invariance.
+
+Correctness currency is the canonical encoding: every device-batched
+leg (hash-to-curve, the one broadcast partial ladder, the Pippenger
+aggregate) is pinned bit-for-bit against its per-element host big-int
+oracle via ``HostGroup.encode``.  Default-tier tests share one tiny
+shape per curve — (2 messages, 3 signers) on an (n=5, t=2) sharing —
+so each curve pays its jit compiles once; the n=64 t=21 BLS12-381
+end-to-end (the ISSUE acceptance shape) rides the slow tier.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import random
+from itertools import combinations
+
+import numpy as np
+import pytest
+
+from dkg_tpu import sign as sg
+from dkg_tpu.groups import device as gd
+from dkg_tpu.groups import host as gh
+from dkg_tpu.sign import partial as sp
+
+CURVES = ["ristretto255", "secp256k1", "bls12_381_g1"]
+
+# The default tier pays ONE device compile chain (ladder + MSM +
+# fixed-base) on secp256k1 and shares it across the module; the same
+# assertions repeat per-curve in the slow tier (the BLS chain alone is
+# ~2 min of XLA:CPU compile).
+DEFAULT_CURVE = "secp256k1"
+TIERED_CURVES = [
+    pytest.param(c, marks=() if c == DEFAULT_CURVE else pytest.mark.slow)
+    for c in CURVES
+]
+
+N, T = 5, 2
+MESSAGES = [b"dkg_tpu sign test message 0", b"dkg_tpu sign test message 1"]
+
+
+def _sharing(curve: str, seed: int = 0x516E) -> tuple[int, list[int]]:
+    """Seeded (N, T) Shamir sharing: (secret, shares at nodes 1..N)."""
+    fs = gh.ALL_GROUPS[curve].scalar_field
+    rng = random.Random(seed)
+    coeffs = [fs.rand_int(rng) for _ in range(T + 1)]
+
+    def horner(x: int) -> int:
+        acc = 0
+        for c in reversed(coeffs):
+            acc = (acc * x + c) % fs.modulus
+        return acc
+
+    return coeffs[0], [horner(i) for i in range(1, N + 1)]
+
+
+@functools.lru_cache(maxsize=None)
+def _base(curve: str):
+    """Per-curve host-side context: sharing, H(m) points, and the
+    expected master signatures — big-int work only, cheap on every
+    curve (the batched hash leg compiles nothing but the BLAKE2b
+    array kernel)."""
+    group = gh.ALL_GROUPS[curve]
+    secret, shares = _sharing(curve)
+    h_points, h_dev = sg.hash_to_curve_batch(curve, MESSAGES)
+    expected = [
+        group.encode(group.scalar_mul_vartime(secret, h)) for h in h_points
+    ]
+    return {
+        "group": group,
+        "secret": secret,
+        "shares": shares,
+        "indices": list(range(1, T + 2)),  # [1, 2, 3]
+        "h_points": h_points,
+        "h_dev": np.asarray(h_dev),
+        "expected_sig": expected,
+    }
+
+
+@functools.lru_cache(maxsize=None)
+def _ctx(curve: str):
+    """_base plus one PROVED (2 messages x 3 signers) partial batch —
+    this is where the per-curve device compile chain (ladder,
+    fixed-base, DLEQ MSM) gets paid, so only DEFAULT_CURVE touches it
+    in the default tier."""
+    base = dict(_base(curve))
+    base["ps"] = sg.partial_sign(
+        curve,
+        [base["shares"][i - 1] for i in base["indices"]],
+        base["indices"],
+        base["h_points"],
+        rng=random.Random(7),
+        prove=True,
+    )
+    return base
+
+
+# ---------------------------------------------------------------- hash2curve
+
+
+@pytest.mark.parametrize("curve", CURVES)
+def test_hash_to_curve_batch_matches_host_oracle(curve):
+    ctx = _base(curve)
+    group = ctx["group"]
+    for i, msg in enumerate(MESSAGES):
+        want = group.encode(sg.hash_to_curve_host(group, msg))
+        assert group.encode(ctx["h_points"][i]) == want
+    # the device limb tensor encodes to the same bytes row by row
+    enc = np.asarray(gd.encode_batch(gd.ALL_CURVES[curve], ctx["h_dev"]))
+    for i, msg in enumerate(MESSAGES):
+        assert enc[i].tobytes() == group.encode(
+            sg.hash_to_curve_host(group, msg)
+        )
+
+
+def test_hash_to_curve_domain_separates():
+    group = gh.ALL_GROUPS["secp256k1"]
+    a = sg.hash_to_curve_host(group, b"msg", b"domain-a")
+    b = sg.hash_to_curve_host(group, b"msg", b"domain-b")
+    assert group.encode(a) != group.encode(b)
+
+
+# ------------------------------------------------------------------ partials
+
+
+@pytest.mark.parametrize("curve", TIERED_CURVES)
+def test_partials_bitexact_vs_host_oracle(curve):
+    """The one broadcast ladder covering the (B, m) grid produces the
+    same points, bit for bit in canonical encoding, as the per-share
+    host big-int loop."""
+    ctx = _ctx(curve)
+    group, ps = ctx["group"], ctx["ps"]
+    signer_shares = [ctx["shares"][i - 1] for i in ctx["indices"]]
+    sigs_host = ps.sigs_host()
+    for bi, h in enumerate(ctx["h_points"]):
+        oracle = sg.partial_sign_host(group, signer_shares, h)
+        for si in range(len(signer_shares)):
+            assert group.encode(sigs_host[bi][si]) == group.encode(oracle[si])
+
+
+def test_host_dispatch_parity():
+    """dispatch="host" (the oracle leg) and the default device leg emit
+    the identical canonical limb tensor."""
+    ctx = _ctx("secp256k1")
+    signer_shares = [ctx["shares"][i - 1] for i in ctx["indices"]]
+    host_ps = sg.partial_sign(
+        "secp256k1", signer_shares, ctx["indices"], ctx["h_points"],
+        dispatch="host",
+    )
+    np.testing.assert_array_equal(host_ps.sigs, ctx["ps"].sigs)
+
+
+@pytest.mark.slow
+def test_message_chunking_is_invisible():
+    """chunk=1 (maximal chunking) concatenates to the same tensor as
+    the unchunked ladder — DKG_TPU_SIGN_BATCH only bounds memory.
+    Slow tier: the 1-message block is its own ladder pad shape (a
+    ~25 s XLA:CPU compile); the knob's parse/precedence contract stays
+    default-tier in test_sign_batch_knob."""
+    ctx = _ctx("secp256k1")
+    signer_shares = [ctx["shares"][i - 1] for i in ctx["indices"]]
+    chunked = sg.partial_sign(
+        "secp256k1", signer_shares, ctx["indices"], ctx["h_points"], chunk=1
+    )
+    np.testing.assert_array_equal(chunked.sigs, ctx["ps"].sigs)
+
+
+def test_partial_sign_rejects_mismatched_inputs():
+    ctx = _ctx("secp256k1")
+    with pytest.raises(ValueError, match="pair up"):
+        sg.partial_sign("secp256k1", ctx["shares"][:2], [1], ctx["h_points"])
+    with pytest.raises(ValueError, match="requires rng"):
+        sg.partial_sign(
+            "secp256k1", ctx["shares"][:1], [1], ctx["h_points"], prove=True
+        )
+
+
+# ------------------------------------------------------------ DLEQ verification
+
+
+def test_verify_partials_accepts_honest_grid():
+    ok = sg.verify_partials(_ctx("secp256k1")["ps"])
+    assert ok.shape == (len(MESSAGES), T + 1)
+    assert ok.all()
+
+
+def test_verify_partials_rejects_forged_partial():
+    """Swapping in another signer's (valid!) partial at one grid cell
+    fails the DLEQ check at exactly that cell: the proof pins the sig
+    to THAT signer's public key."""
+    ps = _ctx("secp256k1")["ps"]
+    forged = dataclasses.replace(ps, sigs=ps.sigs.copy())
+    forged.sigs[1, 1] = ps.sigs[1, 0]
+    ok = sg.verify_partials(forged)
+    assert not ok[1, 1]
+    ok[1, 1] = True
+    assert ok.all(), "only the forged cell may fail"
+
+
+def test_verify_partials_requires_proofs():
+    ctx = _ctx("secp256k1")
+    bare = dataclasses.replace(ctx["ps"], proofs=None)
+    with pytest.raises(ValueError, match="no proofs"):
+        sg.verify_partials(bare)
+
+
+# --------------------------------------------------------------- aggregation
+
+
+@pytest.mark.slow
+def test_aggregate_every_subset_recovers_master_signature():
+    """Any t+1 of the n signers aggregate to the SAME signature —
+    secret * H(m) — for every one of the C(5,3) subsets.  Slow tier:
+    the all-signers grid is a second (2, 5) ladder compile; the
+    default tier covers aggregation on the shared (2, 3) shape."""
+    curve = "secp256k1"
+    ctx = _ctx(curve)
+    group = ctx["group"]
+    all_idx = list(range(1, N + 1))
+    ps = sg.partial_sign(
+        curve, ctx["shares"], all_idx, ctx["h_points"]
+    )
+    for subset in combinations(range(N), T + 1):
+        sigs = sg.signature_encode(curve, sg.aggregate(ps, list(subset)))
+        assert sigs == ctx["expected_sig"], f"subset {subset} disagrees"
+    # and the host Lagrange+MSM oracle agrees with the device aggregate
+    rows = ps.sigs_host()
+    sub = [0, 2, 4]
+    agg_host = sg.aggregate_host(
+        group, [all_idx[p] for p in sub], [[r[p] for p in sub] for r in rows]
+    )
+    assert [group.encode(a) for a in agg_host] == ctx["expected_sig"]
+
+
+@pytest.mark.parametrize("curve", TIERED_CURVES)
+def test_threshold_signature_matches_master_scalar(curve):
+    """End-to-end on the shared tiny shape: aggregate of the proved
+    batch encodes to secret * H(m) for every message."""
+    ctx = _ctx(curve)
+    sigs = sg.signature_encode(curve, sg.aggregate(ctx["ps"]))
+    assert sigs == ctx["expected_sig"]
+
+
+# ------------------------------------------------------------ epoch invariance
+
+
+def test_signature_stable_across_refresh_and_reshare():
+    """Refresh rotates every share and reshare changes the committee
+    shape, but f(0) — and therefore the signature bytes — is invariant
+    (the property that makes proactive refresh deployable)."""
+    from dkg_tpu.epoch import inprocess
+
+    curve = "secp256k1"
+    ctx = _ctx(curve)
+    fs = ctx["group"].scalar_field
+    rng = random.Random(0xE70C)
+    baseline = ctx["expected_sig"]
+
+    refreshed = inprocess.refresh_shares(fs, N, T, ctx["shares"], rng)
+    assert refreshed != ctx["shares"]
+    idx = [2, 4, 5]  # a different t+1 subset of the refreshed committee
+    ps = sg.partial_sign(
+        curve, [refreshed[i - 1] for i in idx], idx, ctx["h_points"]
+    )
+    assert sg.signature_encode(curve, sg.aggregate(ps)) == baseline
+
+    # same threshold so the (2, 3) ladder/aggregate shapes are reused;
+    # the committee still shrinks and every share changes
+    n2, t2 = 4, 2
+    reshared = inprocess.reshare_shares(fs, N, T, refreshed, n2, t2, rng)
+    idx2 = [1, 3, 4]
+    ps2 = sg.partial_sign(
+        curve, [reshared[i - 1] for i in idx2], idx2, ctx["h_points"]
+    )
+    assert sg.signature_encode(curve, sg.aggregate(ps2)) == baseline
+
+
+# ------------------------------------------------------------------- knobs
+
+
+def test_sign_batch_knob(monkeypatch):
+    monkeypatch.delenv("DKG_TPU_SIGN_BATCH", raising=False)
+    assert sp._sign_chunk(None) == 256
+    monkeypatch.setenv("DKG_TPU_SIGN_BATCH", "17")
+    assert sp._sign_chunk(None) == 17
+    assert sp._sign_chunk(4) == 4, "explicit argument beats the knob"
+    monkeypatch.setenv("DKG_TPU_SIGN_BATCH", "")
+    assert sp._sign_chunk(None) == 256, "empty value means unset"
+    for bad in ("0", "-3", "many"):
+        monkeypatch.setenv("DKG_TPU_SIGN_BATCH", bad)
+        with pytest.raises(ValueError):
+            sp._sign_chunk(None)
+    with pytest.raises(ValueError):
+        sp._sign_chunk(0)
+
+
+def test_sign_dispatch_knob(monkeypatch):
+    monkeypatch.delenv("DKG_TPU_SIGN_DISPATCH", raising=False)
+    assert sp._sign_dispatch(None) == "device"
+    monkeypatch.setenv("DKG_TPU_SIGN_DISPATCH", "host")
+    assert sp._sign_dispatch(None) == "host"
+    assert sp._sign_dispatch("device") == "device", "explicit wins"
+    monkeypatch.setenv("DKG_TPU_SIGN_DISPATCH", "")
+    assert sp._sign_dispatch(None) == "device", "empty value means unset"
+    monkeypatch.setenv("DKG_TPU_SIGN_DISPATCH", "gpu")
+    with pytest.raises(ValueError, match="DKG_TPU_SIGN_DISPATCH"):
+        sp._sign_dispatch(None)
+    with pytest.raises(ValueError, match="device|host"):
+        sp._sign_dispatch("gpu")
+
+
+# --------------------------------------------------------------- service lane
+
+
+def test_scheduler_sign_serves_signatures_with_metrics():
+    """CeremonyScheduler.sign over an injected held outcome: canonical
+    bytes equal to secret * H(m), per-ceremony labelled metrics, empty
+    batch short-circuit, and a too-small qualified set refused."""
+    from dkg_tpu.fields import host as fh
+    from dkg_tpu.service.engine import CeremonyOutcome
+    from dkg_tpu.service.scheduler import CeremonyScheduler
+
+    curve = "secp256k1"
+    ctx = _ctx(curve)
+    group = ctx["group"]
+    fs = group.scalar_field
+
+    sch = CeremonyScheduler(
+        concurrency=1, queue_depth=4, batch_max=1, runtime=object()
+    )
+    try:
+        out = CeremonyOutcome(
+            ceremony_id="signtest", status="done", curve=curve, n=N, t=T,
+            master=group.encode(
+                group.scalar_mul_vartime(ctx["secret"], group.generator())
+            ),
+            qualified=(True,) * N,
+            final_shares=np.asarray(fh.encode(fs, ctx["shares"])),
+        )
+        with sch._cond:
+            sch._record(out)
+
+        assert sch.sign("signtest", []) == []
+        sigs = sch.sign("signtest", MESSAGES, seed=3)
+        expected = [
+            group.encode(
+                group.scalar_mul_vartime(
+                    ctx["secret"],
+                    sg.hash_to_curve_host(group, m),
+                )
+            )
+            for m in MESSAGES
+        ]
+        assert sigs == expected
+
+        snap = sch.metrics.snapshot()
+        assert snap["counters"]['sign_requests_total{ceremony="signtest"}'] == 1
+        assert snap["counters"]['sign_messages_total{ceremony="signtest"}'] == len(
+            MESSAGES
+        )
+        assert 'sign_seconds{ceremony="signtest"}' in snap["histograms"]
+
+        # a qualified set below t+1 is refused before any curve work
+        starved = CeremonyOutcome(
+            ceremony_id="starved", status="done", curve=curve, n=N, t=T,
+            master=b"m", qualified=(True, True) + (False,) * (N - 2),
+            final_shares=np.asarray(fh.encode(fs, ctx["shares"])),
+        )
+        with sch._cond:
+            sch._record(starved)
+        with pytest.raises(ValueError, match="qualified signers"):
+            sch.sign("starved", MESSAGES)
+    finally:
+        sch.close()
+
+
+# ------------------------------------------------------------- slow BLS e2e
+
+
+@pytest.mark.slow
+def test_bls_threshold_signature_end_to_end_n64():
+    """ISSUE acceptance shape: n=64, t=21 BLS12-381 G1.  Batched
+    partials for a 4-message batch, DLEQ-batch-verified, Lagrange
+    aggregated, bit-identical to the host big-int oracle, and invariant
+    across a proactive refresh epoch."""
+    from dkg_tpu.epoch import inprocess
+
+    curve = "bls12_381_g1"
+    group = gh.ALL_GROUPS[curve]
+    fs = group.scalar_field
+    n, t = 64, 21
+    rng = random.Random(0xB15)
+    coeffs = [fs.rand_int(rng) for _ in range(t + 1)]
+
+    def horner(x: int) -> int:
+        acc = 0
+        for c in reversed(coeffs):
+            acc = (acc * x + c) % fs.modulus
+        return acc
+
+    secret = coeffs[0]
+    shares = [horner(i) for i in range(1, n + 1)]
+    msgs = [f"bls-e2e message {i}".encode() for i in range(4)]
+    h_points, _ = sg.hash_to_curve_batch(curve, msgs)
+    expected = [
+        group.encode(group.scalar_mul_vartime(secret, h)) for h in h_points
+    ]
+
+    indices = list(range(1, t + 2))
+    ps = sg.partial_sign(
+        curve, [shares[i - 1] for i in indices], indices, h_points,
+        rng=rng, prove=True,
+    )
+    assert sg.verify_partials(ps).all()
+
+    # device aggregate == host Lagrange+MSM oracle == secret * H(m)
+    sigs = sg.signature_encode(curve, sg.aggregate(ps))
+    assert sigs == expected
+    agg_host = sg.aggregate_host(group, indices, ps.sigs_host())
+    assert [group.encode(a) for a in agg_host] == expected
+
+    # refresh epoch: every share rotates, the signature does not —
+    # sign from a DIFFERENT t+1 subset of the refreshed committee
+    refreshed = inprocess.refresh_shares(fs, n, t, shares, rng)
+    assert refreshed != shares
+    idx2 = list(range(42, 42 + t + 1))
+    ps2 = sg.partial_sign(
+        curve, [refreshed[i - 1] for i in idx2], idx2, h_points
+    )
+    assert sg.signature_encode(curve, sg.aggregate(ps2)) == expected
