@@ -38,21 +38,36 @@ def generate_batch(
     cs,
     statements: list[tuple],  # (base1, base2, point1, point2, dlog)
     rng,
-) -> list[DleqZkp]:
+    *,
+    return_announcements: bool = False,
+) -> list[DleqZkp] | tuple[list[DleqZkp], list[tuple]]:
     """Batched prover: all 2k announcement scalar-mults in one device
-    call; challenges + responses finish host-side per proof."""
+    call; challenges + responses finish host-side per proof.
+
+    ``return_announcements=True`` additionally returns the per-proof
+    announcement pairs ``[(a1, a2), ...]`` (host point tuples).  A
+    verifier holding them can check ``z*b_i - e*h_i - a_i == 0`` as a
+    random-linear-combination over many proofs at once instead of
+    recomputing each announcement (sign.verify.rlc_verify) — the
+    transcript already binds them through ``e``, so publishing them
+    reveals nothing the proof did not.
+    """
     if not statements:
-        return []
+        return ([], []) if return_announcements else []
     q = group.scalar_field.modulus
     ws = [group.random_scalar(rng) for _ in statements]
     bases = _pairs_to_device(cs, [s[0] for s in statements], [s[1] for s in statements])
     w_limbs = jnp.asarray(fh.encode(group.scalar_field, [[w, w] for w in ws]))
     ann = gd.to_host(cs, np.asarray(gd.scalar_mul(cs, w_limbs, bases)).reshape(-1, cs.ncoords, cs.field.limbs))
     out = []
+    anns = []
     for i, (b1, b2, h1, h2, x) in enumerate(statements):
         a1, a2 = ann[2 * i], ann[2 * i + 1]
         e = _challenge(group, b1, b2, h1, h2, a1, a2)
         out.append(DleqZkp(e, (ws[i] + e * x) % q))
+        anns.append((a1, a2))
+    if return_announcements:
+        return out, anns
     return out
 
 

@@ -21,20 +21,37 @@ service:
   and optional WAL-backed durability.
 * :mod:`~dkg_tpu.service.durable` — per-ceremony WAL journaling
   (reusing :class:`~dkg_tpu.net.checkpoint.PartyWal`) so a restarted
-  server resumes in-flight ceremonies.
+  server resumes in-flight ceremonies, with a replay-count crash-loop
+  guard poisoning requests that keep taking the process down.
+* :mod:`~dkg_tpu.service.errors` — the typed failure taxonomy
+  (poison vs transient vs backpressure vs signer starvation) the
+  scheduler's isolation machinery branches on (lint DKG010).
+* :mod:`~dkg_tpu.service.faultsvc` — seeded chaos injection for all of
+  the above (scripts/service_storm.py is the harness).
 
 Entry points: :class:`~dkg_tpu.service.scheduler.CeremonyScheduler`,
 :class:`~dkg_tpu.service.engine.CeremonyRequest`.  Knobs (all through
 ``utils.envknobs``): ``DKG_TPU_SERVICE_CONCURRENCY``,
 ``DKG_TPU_SERVICE_QUEUE_DEPTH``, ``DKG_TPU_SERVICE_BATCH_MAX``,
-``DKG_TPU_SERVICE_DEADLINE_S``, ``DKG_TPU_SERVICE_WAL_DIR``.
+``DKG_TPU_SERVICE_DEADLINE_S``, ``DKG_TPU_SERVICE_WAL_DIR``,
+``DKG_TPU_SERVICE_RETRIES``, ``DKG_TPU_SERVICE_RETRY_BACKOFF_S``,
+``DKG_TPU_SERVICE_MAX_REPLAYS``.
 See docs/service.md for the architecture and the bucketing/backpressure
-semantics, and scripts/fleet_bench.py for the throughput benchmark.
+semantics, docs/fault_model.md for the service fault model, and
+scripts/fleet_bench.py for the throughput benchmark.
 """
 
 from .buckets import Bucket, bucket_for, split_widths
 from .engine import CeremonyOutcome, CeremonyRequest, WarmRuntime
-from .scheduler import CeremonyScheduler, QueueFullError
+from .errors import (
+    InsufficientSigners,
+    PoisonedRequest,
+    QueueFullError,
+    ServiceError,
+    TransientEngineError,
+)
+from .faultsvc import ServiceFaultPlan, WorkerCrash, corrupt_journal
+from .scheduler import CeremonyScheduler
 
 __all__ = [
     "Bucket",
@@ -44,5 +61,12 @@ __all__ = [
     "CeremonyRequest",
     "WarmRuntime",
     "CeremonyScheduler",
+    "ServiceError",
     "QueueFullError",
+    "PoisonedRequest",
+    "TransientEngineError",
+    "InsufficientSigners",
+    "ServiceFaultPlan",
+    "WorkerCrash",
+    "corrupt_journal",
 ]

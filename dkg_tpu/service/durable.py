@@ -6,7 +6,7 @@ append-only, checksummed, fsync'd, torn-tail-tolerant record log the
 party runtime checkpoints into, so the service inherits its crash
 semantics for free.
 
-Two record kinds, both JSON bodies with a ``kind`` tag:
+Three record kinds, all JSON bodies with a ``kind`` tag:
 
 * ``req`` — appended at ADMISSION, before submit() returns the ceremony
   id.  Carries the full :class:`~dkg_tpu.service.engine.CeremonyRequest`
@@ -14,16 +14,24 @@ Two record kinds, both JSON bodies with a ``kind`` tag:
   the coefficients, and the re-dealt polynomials are byte-identical by
   the engine's deterministic draw order).
 * ``done`` — appended at COMPLETION (any terminal status: done, failed,
-  expired).  Carries the PUBLIC outcome only — master key, qualified
-  set, complaints.  Share material NEVER touches the journal; a
-  recovered terminal ceremony re-serves its public result, while its
+  expired, poisoned).  Carries the PUBLIC outcome only — master key,
+  qualified set, complaints.  Share material NEVER touches the journal;
+  a recovered terminal ceremony re-serves its public result, while its
   secret shares live only in the process that ran it.
+* ``replay`` — appended each time RECOVERY re-queues a pending
+  ceremony, carrying its cumulative replay count.  This is the
+  crash-loop guard's memory: a request that keeps being mid-flight when
+  the process dies is the prime suspect for WHY it dies, and without a
+  persisted count the restart loop would re-run it forever.  The
+  scheduler poisons a pending ceremony whose count reaches
+  ``DKG_TPU_SERVICE_MAX_REPLAYS`` instead of re-queueing it.
 
 Recovery (:meth:`ServiceJournal.replay`) partitions replayed ids into
 *pending* (req without done — resubmitted and re-run from the seed) and
-*terminal* (req+done — their outcomes re-served directly).  The
-scheduler compacts the journal on recovery via ``PartyWal.rewrite`` so
-a torn tail never shadows post-restart appends.
+*terminal* (req+done — their outcomes re-served directly), plus the
+*replays* count map.  The scheduler compacts the journal on recovery
+via ``PartyWal.rewrite`` so a torn tail never shadows post-restart
+appends.
 """
 
 from __future__ import annotations
@@ -76,11 +84,18 @@ def _done_body(out: CeremonyOutcome) -> bytes:
     ).encode()
 
 
+def _replay_body(cid: str, count: int) -> bytes:
+    return json.dumps(
+        {"kind": "replay", "id": cid, "count": count}, sort_keys=True
+    ).encode()
+
+
 class ServiceJournal:
     """The scheduler's durability sink.  All writes happen under the
     scheduler's own locks (admission lock for ``record_request``, the
-    completing worker for ``record_done``), so the journal itself needs
-    no locking beyond PartyWal's single-write appends."""
+    completing worker for ``record_done``, recovery for
+    ``record_replay``), so the journal itself needs no locking beyond
+    PartyWal's single-write appends."""
 
     def __init__(self, directory) -> None:
         self.wal = PartyWal(service_wal_path(directory))
@@ -91,15 +106,23 @@ class ServiceJournal:
     def record_done(self, out: CeremonyOutcome) -> None:
         self.wal.append(_done_body(out))
 
+    def record_replay(self, cid: str, count: int) -> None:
+        """Persist that ``cid`` is being re-queued for the ``count``-th
+        time (crash-loop guard; see module docstring)."""
+        self.wal.append(_replay_body(cid, count))
+
     def replay(self):
-        """(pending, terminal): ``pending`` maps ceremony id ->
+        """(pending, terminal, replays): ``pending`` maps ceremony id ->
         ``(seq, CeremonyRequest)`` for admitted-but-unfinished
         ceremonies; ``terminal`` maps id -> public
-        :class:`CeremonyOutcome`.  Unparseable bodies are skipped (the
-        frame checksum already passed, so these are version skew, not
-        corruption — better to recover the rest than refuse)."""
+        :class:`CeremonyOutcome`; ``replays`` maps id -> cumulative
+        recovery re-queue count (later records win — counts only grow).
+        Unparseable bodies are skipped (the frame checksum already
+        passed, so these are version skew, not corruption — better to
+        recover the rest than refuse)."""
         pending: dict = {}
         terminal: dict = {}
+        replays: dict = {}
         for body in self.wal.replay():
             try:
                 rec = json.loads(body)
@@ -142,15 +165,34 @@ class ServiceJournal:
                     ),
                     error=rec.get("error", ""),
                 )
-        return pending, terminal
+            elif kind == "replay":
+                cid = rec.get("id")
+                if cid is None:
+                    continue
+                try:
+                    count = int(rec.get("count", 0))
+                except (TypeError, ValueError):
+                    continue
+                replays[cid] = max(replays.get(cid, 0), count)
+        return pending, terminal, replays
 
-    def compact(self, pending: dict, terminal: dict) -> None:
+    def compact(
+        self, pending: dict, terminal: dict, replays: dict | None = None
+    ) -> None:
         """Rewrite the journal to exactly the replayed state (pending
-        reqs + terminal dones — a ``done`` record is self-contained, so
-        terminal ceremonies need no ``req`` twin), dropping any torn
-        tail so post-restart appends cannot be shadowed by it."""
+        reqs + their replay counts + terminal dones — a ``done`` record
+        is self-contained, so terminal ceremonies need no ``req`` twin),
+        dropping any torn tail so post-restart appends cannot be
+        shadowed by it.  Replay counts for non-pending ids are dropped:
+        the guard only ever consults them for pending ceremonies."""
         bodies = [
             _req_body(cid, seq, req) for cid, (seq, req) in pending.items()
         ]
+        if replays:
+            bodies.extend(
+                _replay_body(cid, count)
+                for cid, count in replays.items()
+                if cid in pending
+            )
         bodies.extend(_done_body(out) for out in terminal.values())
         self.wal.rewrite(bodies)

@@ -53,6 +53,7 @@ from ..groups import device as gd
 from ..groups import host as gh
 from ..groups import precompute as gp
 from . import buckets
+from .errors import PoisonedRequest
 
 #: Default domain-separation string for service ceremonies (requests may
 #: override; the commitment key h derives from it).
@@ -438,7 +439,7 @@ def run_single_reference(req: CeremonyRequest) -> bytes:
     )
     out = c.run(rho_bits=req.rho_bits)
     if "master" not in out:
-        raise RuntimeError(f"reference ceremony failed: {out.get('error')}")
+        raise PoisonedRequest(f"reference ceremony failed: {out.get('error')}")
     cs = c.cfg.cs
     return gd.encode_batch(cs, np.asarray(out["master"])[None])[0].tobytes()
 

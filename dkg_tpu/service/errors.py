@@ -1,0 +1,65 @@
+"""Typed service/signing error taxonomy (lint DKG010).
+
+The serving path must never amplify a single fault into an opaque
+``RuntimeError`` that callers cannot classify: the scheduler's retry /
+bisect / quarantine machinery branches on *what kind* of failure it is
+looking at, and an HTTP front-end maps each type to a distinct status
+code.  Lint rule DKG010 (scripts/lint_lite.py) therefore bans bare
+``raise RuntimeError`` in ``dkg_tpu/service/`` and ``dkg_tpu/sign/`` —
+everything raised there is one of these.
+
+Taxonomy:
+
+* :class:`TransientEngineError` — the ONLY class the scheduler retries.
+  A fault is transient exactly when the raiser says so (device resets,
+  injected chaos); arbitrary exceptions are never *guessed* transient,
+  because retrying a poisoned request just re-poisons the convoy.
+* :class:`PoisonedRequest` — a request that fails on its own at width
+  1: bisection has excluded convoy-mates as the cause.  Surfaced as the
+  ``poisoned`` terminal status (the outcome's ``error`` names this
+  type), and raised directly by single-request paths.
+* :class:`InsufficientSigners` — signing cannot reach a t+1 quorum of
+  honest qualified signers (quarantine ate the margin).  Subclasses
+  ``ValueError`` too: the pre-quarantine precondition check raised
+  ValueError, and existing catch sites keep working.
+* :class:`QueueFullError` — admission backpressure (HTTP 503); lives
+  here with the rest of the taxonomy, re-exported by
+  ``service.scheduler`` where it historically lived.
+"""
+
+from __future__ import annotations
+
+
+class ServiceError(RuntimeError):
+    """Base of every typed serving-path error.  Subclasses RuntimeError
+    so pre-taxonomy catch sites (``except RuntimeError``) still work."""
+
+
+class QueueFullError(ServiceError):
+    """Admission queue at capacity — the caller should back off and
+    retry (HTTP 503).  Raised instead of blocking: a DKG client can
+    retry cheaply, while an unbounded queue turns overload into
+    unbounded latency for everyone already queued."""
+
+
+class TransientEngineError(ServiceError):
+    """An engine fault the raiser asserts is worth retrying (the whole
+    convoy re-runs, bounded by ``DKG_TPU_SERVICE_RETRIES`` with
+    exponential backoff).  Nothing else is retried: transiency is a
+    claim only the fault's origin can make."""
+
+
+class PoisonedRequest(ServiceError):
+    """A request that fails deterministically on its own — convoy
+    bisection has run it at width 1, so healthy convoy-mates are
+    exonerated.  Its outcome is terminal status ``poisoned``; retrying
+    it anywhere (including journal replay, see
+    ``DKG_TPU_SERVICE_MAX_REPLAYS``) is wasted work."""
+
+
+class InsufficientSigners(ServiceError, ValueError):
+    """Fewer than t+1 honest qualified signers remain for a ceremony —
+    either the qualification set was too small to begin with, or signer
+    quarantine (Byzantine partials caught by RLC blame) consumed the
+    substitution margin.  ValueError subclass for backward
+    compatibility with the pre-quarantine precondition error."""

@@ -32,13 +32,28 @@ Flow:
   at finish (a ceremony that expired mid-flight reports ``expired``,
   not ``done``).
 
+Blast-radius isolation (docs/fault_model.md "Service fault model"): a
+convoy failure no longer dooms its width-W members wholesale.
+:class:`~dkg_tpu.service.errors.TransientEngineError` retries the whole
+convoy (bounded, exponential backoff); anything else BISECTS down the
+width ladder — healthy halves complete normally, and the request that
+still fails alone at width 1 gets the terminal ``poisoned`` status
+(error names :class:`~dkg_tpu.service.errors.PoisonedRequest`).  A
+watchdog thread respawns workers killed by non-``Exception`` escapes
+and re-queues (once) the convoys they held.  Signing survives Byzantine
+partials via RLC blame + per-ceremony signer quarantine (:meth:`sign`).
+
 Knobs (all validated through utils.envknobs; constructor arguments
 win): ``DKG_TPU_SERVICE_CONCURRENCY`` (workers, default 4),
 ``DKG_TPU_SERVICE_QUEUE_DEPTH`` (admission bound, default 256),
 ``DKG_TPU_SERVICE_BATCH_MAX`` (max convoy width, default 8, capped by
 the bucket ladder), ``DKG_TPU_SERVICE_DEADLINE_S`` (default per-request
 deadline, unset = none), ``DKG_TPU_SERVICE_WAL_DIR`` (durability
-journal directory, unset = durability off).
+journal directory, unset = durability off), ``DKG_TPU_SERVICE_RETRIES``
+(transient-fault convoy retries, default 2, 0 disables),
+``DKG_TPU_SERVICE_RETRY_BACKOFF_S`` (first backoff, doubling, default
+0.05), ``DKG_TPU_SERVICE_MAX_REPLAYS`` (journal crash-loop guard,
+default 3 — see service.durable).
 """
 
 from __future__ import annotations
@@ -55,7 +70,7 @@ from ..fields import host as fh
 from ..groups import host as gh
 from ..utils import envknobs, obslog
 from ..utils.metrics import REGISTRY
-from . import buckets
+from . import buckets, errors
 from .durable import ServiceJournal
 from .engine import (
     CeremonyOutcome,
@@ -65,23 +80,24 @@ from .engine import (
     request_id,
     start_convoy,
 )
+from .errors import QueueFullError  # noqa: F401 — historical home, re-exported
 
-
-class QueueFullError(RuntimeError):
-    """Admission queue at capacity — the caller should back off and
-    retry (HTTP 503).  Raised instead of blocking: a DKG client can
-    retry cheaply, while an unbounded queue turns overload into
-    unbounded latency for everyone already queued."""
+#: How many times a convoy orphaned by a crashed worker is re-queued
+#: before its members fail with WORKER_CRASH.  One: the convoy itself
+#: may be what killed the worker, so unbounded re-queueing would turn a
+#: poisoned request into a worker crash-loop.
+_MAX_CRASH_REQUEUES = 1
 
 
 class _Pending:
-    __slots__ = ("cid", "seq", "req", "deadline_at")
+    __slots__ = ("cid", "seq", "req", "deadline_at", "crashes")
 
     def __init__(self, cid, seq, req, deadline_at):
         self.cid = cid
         self.seq = seq
         self.req = req
         self.deadline_at = deadline_at
+        self.crashes = 0  # worker-crash orphanings survived so far
 
 
 class CeremonyScheduler:
@@ -99,6 +115,12 @@ class CeremonyScheduler:
         batch_max: int | None = None,
         deadline_s: float | None = None,
         wal_dir: str | None = None,
+        retries: int | None = None,
+        retry_backoff_s: float | None = None,
+        max_replays: int | None = None,
+        watchdog_interval_s: float = 0.5,
+        fault_plan=None,
+        log=None,
         runtime: WarmRuntime | None = None,
         metrics=REGISTRY,
     ) -> None:
@@ -122,19 +144,46 @@ class CeremonyScheduler:
             wal_dir = envknobs.string(
                 "DKG_TPU_SERVICE_WAL_DIR", "service durability journal directory"
             )
+        if retries is None:
+            retries = envknobs.nonneg_int(
+                "DKG_TPU_SERVICE_RETRIES",
+                "transient-fault convoy retries (0 disables)",
+            )
+            retries = 2 if retries is None else retries
+        if retry_backoff_s is None:
+            retry_backoff_s = envknobs.nonneg_float(
+                "DKG_TPU_SERVICE_RETRY_BACKOFF_S",
+                "first transient-retry backoff, doubling per attempt",
+            )
+            retry_backoff_s = 0.05 if retry_backoff_s is None else retry_backoff_s
+        if max_replays is None:
+            max_replays = envknobs.pos_int(
+                "DKG_TPU_SERVICE_MAX_REPLAYS",
+                "journal replays before a pending ceremony is poisoned",
+            ) or 3
         self.concurrency = concurrency
         self.queue_depth = queue_depth
         self.batch_max = min(batch_max, buckets.WIDTHS[0])
         self.default_deadline_s = deadline_s
+        self.retries = retries
+        self.retry_backoff_s = retry_backoff_s
+        self.max_replays = max_replays
         self.runtime = runtime if runtime is not None else WarmRuntime()
         self.metrics = metrics
+        self._fault_plan = fault_plan
+        self._own_log = log is None
+        self._log = log if log is not None else obslog.from_env()
         self._cond = threading.Condition()
         self._queue: list[_Pending] = []
         self._results: dict[str, CeremonyOutcome] = {}
         self._status: dict[str, str] = {}
+        self._quarantine: dict[str, set[int]] = {}
+        self._held: dict[int, list] = {}  # worker slot -> convoys in hand
         self._seq = 0
+        self._gen = 0  # respawn generation, for unique thread names
         self._running = True
         self._draining = False
+        self._watchdog_interval_s = watchdog_interval_s
         self._journal = ServiceJournal(wal_dir) if wal_dir else None
         if self._journal is not None:
             self._recover()
@@ -143,12 +192,16 @@ class CeremonyScheduler:
         # ceremony workers
         self._workers = [
             threading.Thread(
-                target=self._worker, name=f"dkg-svc-{i}", daemon=True
+                target=self._worker, args=(i,), name=f"dkg-svc-{i}", daemon=True
             )
             for i in range(concurrency)
         ]
         for w in self._workers:
             w.start()
+        self._watchdog = threading.Thread(
+            target=self._watchdog_loop, name="dkg-svc-watchdog", daemon=True
+        )
+        self._watchdog.start()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -188,27 +241,66 @@ class CeremonyScheduler:
             self._cond.notify_all()
         for w in self._workers:
             w.join(timeout=60)
+        self._watchdog.join(timeout=60)
+        if self._own_log and self._log is not None:
+            self._log.close()
 
     def _recover(self) -> None:
         """Replay the journal: re-serve terminal outcomes, resubmit
         pending (admitted-but-unfinished) ceremonies under their
-        original ids, and compact the log."""
-        pending, terminal = self._journal.replay()
-        self._journal.compact(pending, terminal)
+        original ids, and compact the log.
+
+        Crash-loop guard: a pending ceremony already replayed
+        ``max_replays`` times is the likely CAUSE of the crashes it
+        keeps surviving — it completes as ``poisoned`` instead of being
+        re-queued for another round of taking the process down."""
+        pending, terminal, replays = self._journal.replay()
+        self._journal.compact(pending, terminal, replays)
         for cid, out in terminal.items():
             self._results[cid] = out
             self._status[cid] = out.status
         now = time.monotonic()
+        recovered = 0
         for cid, (seq, req) in pending.items():
             self._seq = max(self._seq, seq + 1)
+            count = replays.get(cid, 0)
+            if count >= self.max_replays:
+                self.metrics.inc("service_poisoned_total")
+                self._emit(
+                    "service_replay_poisoned", ceremony=cid, replays=count
+                )
+                out = CeremonyOutcome(
+                    ceremony_id=cid,
+                    status="poisoned",
+                    curve=req.curve,
+                    n=req.n,
+                    t=req.t,
+                    error=(
+                        f"PoisonedRequest: REPLAY_LIMIT "
+                        f"(replayed {count}x, max {self.max_replays})"
+                    ),
+                )
+                self._journal.record_done(out)
+                self._results[cid] = out
+                self._status[cid] = out.status
+                continue
+            self._journal.record_replay(cid, count + 1)
             deadline = (
                 now + req.deadline_s if req.deadline_s is not None else None
             )
             self._queue.append(_Pending(cid, seq, req, deadline))
             self._status[cid] = "queued"
+            recovered += 1
         self.metrics.set_gauge("service_queue_depth", len(self._queue))
-        if pending:
-            self.metrics.inc("service_recovered_total", len(pending))
+        if recovered:
+            self.metrics.inc("service_recovered_total", recovered)
+
+    def _emit(self, kind: str, **fields) -> None:
+        """Flight-recorder event, KIND-only error attribution — never a
+        message payload (redaction contract: an exception string may
+        embed share/seed material; the emitted stream must not)."""
+        if self._log is not None:
+            self._log.emit(kind, **fields)
 
     # -- client surface -----------------------------------------------------
 
@@ -235,9 +327,12 @@ class CeremonyScheduler:
         )
         with self._cond:
             if not self._running or self._draining:
+                self.metrics.inc("service_rejected_total")
+                self._emit("service_rejected", error_kind="SHUTTING_DOWN")
                 raise QueueFullError("scheduler is shutting down")
             if len(self._queue) >= self.queue_depth:
                 self.metrics.inc("service_rejected_total")
+                self._emit("service_rejected", error_kind="QUEUE_FULL")
                 raise QueueFullError(
                     f"admission queue full ({self.queue_depth})"
                 )
@@ -257,8 +352,9 @@ class CeremonyScheduler:
         return cid
 
     def poll(self, cid: str) -> str:
-        """Current status: queued | running | done | failed | expired —
-        or ``unknown`` for an id this scheduler never admitted."""
+        """Current status: queued | running | done | failed | expired |
+        poisoned — or ``unknown`` for an id this scheduler never
+        admitted."""
         with self._cond:
             return self._status.get(cid, "unknown")
 
@@ -279,6 +375,12 @@ class CeremonyScheduler:
                         )
                 self._cond.wait(timeout=remain)
             return self._results[cid]
+
+    def quarantined(self, cid: str) -> frozenset[int]:
+        """The 1-based signer indices quarantined for ceremony ``cid``
+        (Byzantine partials caught by :meth:`sign`'s RLC blame)."""
+        with self._cond:
+            return frozenset(self._quarantine.get(cid, ()))
 
     # -- epoch operations against a held outcome ----------------------------
 
@@ -395,6 +497,7 @@ class CeremonyScheduler:
         *,
         prove: bool = True,
         seed: int | None = None,
+        tamper=None,
     ) -> list[bytes]:
         """Threshold-sign a whole message batch under ceremony ``cid``:
         one canonical signature encoding per message.
@@ -403,10 +506,24 @@ class CeremonyScheduler:
         in one counter-batched pass (sign.hash2curve), all B x (t+1)
         partials run as one batched ladder (sign.partial), and the
         aggregation is one Pippenger MSM with the message batch as a
-        leading axis (sign.aggregate).  With ``prove`` (the default)
-        each partial carries a DLEQ proof and the whole grid is checked
-        in one ``dleq_batch.verify_batch`` pass before aggregation — a
-        corrupted partial raises instead of producing a bad signature.
+        leading axis (sign.aggregate).
+
+        Byzantine tolerance (``prove=True``, the default): the quorum is
+        a seed-derived rotation over the ELIGIBLE signers (qualified
+        minus this ceremony's quarantine), the whole partial grid is
+        checked with ONE RLC-combined pass (sign.verify.rlc_verify), and
+        a failing grid is bisected to the exact bad (message, signer)
+        cells — the blamed signers join the per-ceremony quarantine and
+        the batch transparently re-signs with substitute signers.  By
+        Lagrange-at-zero algebra every honest quorum encodes the SAME
+        signature bytes, so substitution is invisible to the caller.
+        :class:`~dkg_tpu.service.errors.InsufficientSigners` (a
+        ValueError) is raised only when eligible signers drop below t+1.
+
+        ``tamper`` is the chaos hook (mirrors ``BatchedCeremony.run``'s):
+        called with each attempt's PartialSignatures before
+        verification; tests and scripts/service_storm.py use it to play
+        the Byzantine signer.
 
         Like refresh/reshare this runs on the caller's thread against a
         snapshot of the held shares; it never mutates the outcome, so
@@ -414,6 +531,7 @@ class CeremonyScheduler:
         signatures they produce are identical).
         """
         from .. import sign as signing
+        from ..sign import verify as sign_verify
 
         if not msgs:
             return []
@@ -425,33 +543,70 @@ class CeremonyScheduler:
             shares = [int(v) for v in fh.decode(fs, out.final_shares)]
             qualified = out.qualified
             curve, t = out.curve, out.t
-        indices = [i + 1 for i, q in enumerate(qualified) if q]
-        if len(indices) < t + 1:
-            raise ValueError(
-                f"ceremony {cid} has {len(indices)} qualified signers, "
-                f"needs t+1={t + 1}"
-            )
-        indices = indices[: t + 1]
-        signer_shares = [shares[i - 1] for i in indices]
+            quarantined = set(self._quarantine.get(cid, ()))
+        eligible = [
+            i + 1
+            for i, q in enumerate(qualified)
+            if q and (i + 1) not in quarantined
+        ]
         h_points, _ = signing.hash_to_curve_batch(curve, list(msgs))
         t_hash = time.monotonic()
         rng = random.Random(seed) if seed is not None else random.SystemRandom()
-        ps = signing.partial_sign(
-            curve, signer_shares, indices, h_points, rng=rng, prove=prove
-        )
-        if prove:
-            ok = signing.verify_partials(ps)
-            if not ok.all():
-                bad = int((~ok).sum())
-                raise RuntimeError(
-                    f"{bad} partial signature(s) failed DLEQ verification "
-                    f"for ceremony {cid}"
+        passes = 0
+        resigns = 0
+        while True:
+            if len(eligible) < t + 1:
+                self.metrics.inc("sign_starved_total", ceremony=cid)
+                self._emit(
+                    "sign_starved", ceremony=cid,
+                    eligible=len(eligible), need=t + 1,
                 )
+                raise errors.InsufficientSigners(
+                    f"ceremony {cid} has {len(eligible)} eligible "
+                    f"qualified signers, needs t+1={t + 1}"
+                )
+            # seed-derived quorum rotation: never always-first-t+1, so
+            # load (and exposure) spreads across the qualified set
+            quorum = sorted(rng.sample(eligible, t + 1))
+            ps = signing.partial_sign(
+                curve,
+                [shares[i - 1] for i in quorum],
+                quorum,
+                h_points,
+                rng=rng,
+                prove=prove,
+            )
+            if tamper is not None:
+                ps = tamper(ps) or ps
+            if not prove:
+                break
+            report = sign_verify.rlc_verify(ps, rng=rng)
+            passes += report.passes
+            if report.ok:
+                break
+            blamed = sorted({quorum[si] for (_bi, si) in report.bad_cells})
+            resigns += 1
+            with self._cond:
+                self._quarantine.setdefault(cid, set()).update(blamed)
+            self.metrics.inc(
+                "sign_quarantined_total", len(blamed), ceremony=cid
+            )
+            self.metrics.inc("sign_resigns_total", ceremony=cid)
+            self._emit(
+                "sign_blame",
+                ceremony=cid,
+                blamed=blamed,
+                cells=[list(c) for c in report.bad_cells],
+                passes=report.passes,
+            )
+            eligible = [i for i in eligible if i not in blamed]
         t_partial = time.monotonic()
         sigs = signing.signature_encode(curve, signing.aggregate(ps))
         dt = time.monotonic() - t0
         self.metrics.inc("sign_requests_total", ceremony=cid)
         self.metrics.inc("sign_messages_total", len(msgs), ceremony=cid)
+        if passes:
+            self.metrics.inc("sign_rlc_passes_total", passes, ceremony=cid)
         self.metrics.observe("sign_seconds", dt, ceremony=cid)
         log = obslog.current()
         if log is not None:
@@ -468,8 +623,10 @@ class CeremonyScheduler:
                 ceremony=cid,
                 curve=curve,
                 messages=len(msgs),
-                signers=len(indices),
+                signers=len(quorum),
                 proved=prove,
+                rlc_passes=passes,
+                resigns=resigns,
             )
         return sigs
 
@@ -492,6 +649,10 @@ class CeremonyScheduler:
                 ]
                 for p in expired:
                     self._queue.remove(p)
+                    self.metrics.inc("service_expired_total", where="queued")
+                    self._emit(
+                        "service_expired", ceremony=p.cid, where="queued"
+                    )
                     self._finish_one(
                         CeremonyOutcome(
                             ceremony_id=p.cid,
@@ -524,39 +685,135 @@ class CeremonyScheduler:
             self._cond.notify_all()
             return convoy
 
-    def _worker(self) -> None:
+    def _engine_start(self, reqs, cids):
+        """Dispatch a convoy, routing through the chaos hook when a
+        fault plan is installed (service.faultsvc)."""
+        if self._fault_plan is not None:
+            self._fault_plan.on_start(reqs)
+        return start_convoy(self.runtime, reqs, cids)
+
+    def _engine_finish(self, fl, reqs):
+        if self._fault_plan is not None:
+            self._fault_plan.on_finish(reqs)
+        return finish_convoy(self.runtime, fl)
+
+    def _run_once(self, convoy):
+        """Synchronous start+finish of a (sub-)convoy — the bisection /
+        retry lane, off the two-deep pipeline."""
+        reqs = [p.req for p in convoy]
+        fl = self._engine_start(reqs, [p.cid for p in convoy])
+        return self._engine_finish(fl, reqs)
+
+    def _hold(self, slot: int, convoy) -> None:
+        with self._cond:
+            self._held.setdefault(slot, []).append(convoy)
+
+    def _release(self, slot: int, convoy) -> None:
+        with self._cond:
+            held = self._held.get(slot, [])
+            if convoy in held:
+                held.remove(convoy)
+
+    def _worker(self, slot: int) -> None:
         inflight = None  # (convoy, InFlight, t_start)
         while True:
             convoy = self._pop_convoy(block=inflight is None)
             if convoy is not None:
+                self._hold(slot, convoy)
                 t0 = time.monotonic()
                 try:
-                    fl = start_convoy(
-                        self.runtime,
-                        [p.req for p in convoy],
-                        [p.cid for p in convoy],
+                    fl = self._engine_start(
+                        [p.req for p in convoy], [p.cid for p in convoy]
                     )
                 except Exception as exc:  # noqa: BLE001 — worker must survive
-                    self._fail_convoy(convoy, exc)
+                    self._isolate(convoy, exc, t0)
+                    self._release(slot, convoy)
                     continue
                 if inflight is not None:
                     self._finish(*inflight)
+                    self._release(slot, inflight[0])
                 inflight = (convoy, fl, t0)
                 continue
             if inflight is not None:
                 self._finish(*inflight)
+                self._release(slot, inflight[0])
                 inflight = None
                 continue
             with self._cond:
                 if not self._running or (self._draining and not self._queue):
                     return
 
+    def _watchdog_loop(self) -> None:
+        """Detect and respawn dead workers (non-``Exception`` escapes or
+        bookkeeping bugs kill a thread silently — without this the pool
+        just shrinks until the service deadlocks).  Convoys the dead
+        worker held are re-queued once, then failed: the convoy may be
+        what killed it (see :data:`_MAX_CRASH_REQUEUES`)."""
+        while True:
+            with self._cond:
+                self._cond.wait(timeout=self._watchdog_interval_s)
+                if not self._running:
+                    return
+                for i, w in enumerate(self._workers):
+                    if w.is_alive():
+                        continue
+                    orphans = self._held.pop(i, [])
+                    self._gen += 1
+                    nw = threading.Thread(
+                        target=self._worker,
+                        args=(i,),
+                        name=f"dkg-svc-{i}r{self._gen}",
+                        daemon=True,
+                    )
+                    self._workers[i] = nw
+                    nw.start()
+                    self.metrics.inc("service_worker_restarts_total")
+                    self._emit("service_worker_restart", slot=i)
+                    for convoy in orphans:
+                        for p in convoy:
+                            p.crashes += 1
+                            if p.crashes > _MAX_CRASH_REQUEUES:
+                                self._emit(
+                                    "service_worker_crash_failed",
+                                    ceremony=p.cid,
+                                )
+                                self.metrics.inc(
+                                    "service_failed_total",
+                                    kind="WORKER_CRASH",
+                                )
+                                self._finish_one(
+                                    CeremonyOutcome(
+                                        ceremony_id=p.cid,
+                                        status="failed",
+                                        curve=p.req.curve,
+                                        n=p.req.n,
+                                        t=p.req.t,
+                                        error=(
+                                            "WORKER_CRASH: worker died "
+                                            f"{p.crashes}x holding this "
+                                            "request"
+                                        ),
+                                    ),
+                                    durable=p.req.durable,
+                                )
+                            else:
+                                self._queue.insert(0, p)
+                                self._status[p.cid] = "queued"
+                                self.metrics.inc("service_requeued_total")
+                    self.metrics.set_gauge(
+                        "service_queue_depth", len(self._queue)
+                    )
+                    self._cond.notify_all()
+
     def _finish(self, convoy, fl, t0) -> None:
         try:
-            outcomes = finish_convoy(self.runtime, fl)
+            outcomes = self._engine_finish(fl, [p.req for p in convoy])
         except Exception as exc:  # noqa: BLE001 — worker must survive
-            self._fail_convoy(convoy, exc)
+            self._isolate(convoy, exc, t0)
             return
+        self._finish_outcomes(convoy, outcomes, t0)
+
+    def _finish_outcomes(self, convoy, outcomes, t0) -> None:
         dt = time.monotonic() - t0
         # per-ceremony attribution: a width-w convoy's wall clock is
         # shared by w ceremonies (the whole-convoy time goes to the
@@ -568,6 +825,10 @@ class CeremonyScheduler:
                 p.deadline_at is not None
                 and time.monotonic() > p.deadline_at
             ):
+                self.metrics.inc("service_expired_total", where="inflight")
+                self._emit(
+                    "service_expired", ceremony=p.cid, where="inflight"
+                )
                 out = CeremonyOutcome(
                     ceremony_id=out.ceremony_id,
                     status="expired",
@@ -583,7 +844,102 @@ class CeremonyScheduler:
             "service_convoy_seconds", dt, width=str(len(convoy))
         )
 
+    # -- blast-radius isolation ---------------------------------------------
+
+    def _isolate(self, convoy, exc, t0) -> None:
+        """A (sub-)convoy raised ``exc``: contain the blast radius.
+
+        Typed :class:`~dkg_tpu.service.errors.TransientEngineError`
+        retries the WHOLE convoy (bounded, exponential backoff) — the
+        work is presumed good, the engine hiccuped.  Everything else is
+        presumed poison and bisected down the width ladder: healthy
+        halves complete bit-identically to an undisturbed run, and the
+        request still failing alone at width 1 is the culprit."""
+        if isinstance(exc, errors.TransientEngineError):
+            exc = self._retry_transient(convoy, exc, t0)
+            if exc is None:
+                return  # recovered; outcomes already recorded
+            if isinstance(exc, errors.TransientEngineError):
+                self._fail_convoy(convoy, exc)  # retries exhausted
+                return
+            # a retry surfaced a non-transient fault: bisect it
+        if len(convoy) == 1:
+            self._poison_one(convoy[0], exc)
+            return
+        self.metrics.inc("service_convoy_bisections_total")
+        self._emit(
+            "service_convoy_bisect",
+            width=len(convoy),
+            error_kind=type(exc).__name__,
+        )
+        mid = len(convoy) // 2
+        for half in (convoy[:mid], convoy[mid:]):
+            t1 = time.monotonic()
+            try:
+                outs = self._run_once(half)
+            except Exception as e2:  # noqa: BLE001 — isolation must conclude
+                self._isolate(half, e2, t1)
+            else:
+                self._finish_outcomes(half, outs, t1)
+
+    def _retry_transient(self, convoy, exc, t0):
+        """Bounded whole-convoy retry for a transient engine fault.
+        Returns None when a retry succeeded (outcomes recorded), the
+        last TransientEngineError when retries are exhausted, or a
+        non-transient exception a retry surfaced (caller bisects)."""
+        last = exc
+        for attempt in range(1, self.retries + 1):
+            self.metrics.inc("service_retries_total")
+            self._emit(
+                "service_retry", attempt=attempt, width=len(convoy),
+                error_kind=type(last).__name__,
+            )
+            time.sleep(self.retry_backoff_s * (2 ** (attempt - 1)))
+            try:
+                outs = self._run_once(convoy)
+            except errors.TransientEngineError as e2:
+                last = e2
+                self._emit(
+                    "service_retry_failed", attempt=attempt,
+                    error_kind=type(e2).__name__,
+                )
+                continue
+            except Exception as e2:  # noqa: BLE001 — classified by caller
+                self._emit(
+                    "service_retry_surfaced", attempt=attempt,
+                    error_kind=type(e2).__name__,
+                )
+                return e2
+            self._finish_outcomes(convoy, outs, t0)
+            return None
+        return last
+
+    def _poison_one(self, p, exc) -> None:
+        """Width-1 failure: the request is the culprit — typed poisoned
+        outcome, convoy-mates (if any) already completed elsewhere."""
+        self.metrics.inc("service_poisoned_total")
+        self._emit(
+            "service_poisoned", ceremony=p.cid, error_kind=type(exc).__name__
+        )
+        self._finish_one(
+            CeremonyOutcome(
+                ceremony_id=p.cid,
+                status="poisoned",
+                curve=p.req.curve,
+                n=p.req.n,
+                t=p.req.t,
+                error=f"PoisonedRequest: {type(exc).__name__}: {exc}",
+            ),
+            durable=p.req.durable,
+        )
+
     def _fail_convoy(self, convoy, exc) -> None:
+        """Terminal whole-convoy failure (transient retries exhausted,
+        shutdown races): every member fails with the error KIND
+        metric-labelled and obslog'd — no silent outcomes."""
+        kind = type(exc).__name__
+        self.metrics.inc("service_failed_total", len(convoy), kind=kind)
+        self._emit("service_convoy_failed", width=len(convoy), error_kind=kind)
         with self._cond:
             for p in convoy:
                 self._finish_one(

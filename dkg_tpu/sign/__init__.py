@@ -14,10 +14,15 @@ threshold-signature service, pairing-free by construction:
 * :mod:`.aggregate` — Lagrange aggregation at zero over any t+1 subset,
   one batched Pippenger MSM across all messages, cross-checked against
   a host big-int oracle.
+* :mod:`.verify` — RLC-combined grid verification with bisecting blame:
+  accept an all-honest grid in ONE combined check, locate Byzantine
+  (message, signer) cells in O(log) further checks — the primitive
+  behind the scheduler's signer quarantine.
 
 Service integration is ``service.scheduler.CeremonyScheduler.sign``.
 Knobs (utils.envknobs, explicit arguments win): ``DKG_TPU_SIGN_BATCH``
-(device message-chunk size), ``DKG_TPU_SIGN_DISPATCH`` (device|host).
+(device message-chunk size), ``DKG_TPU_SIGN_DISPATCH`` (device|host),
+``DKG_TPU_SIGN_RLC_DISPATCH`` (host|device RLC combine leg).
 """
 
 from .aggregate import aggregate, aggregate_host, signature_encode
@@ -29,9 +34,11 @@ from .partial import (
     public_keys,
     verify_partials,
 )
+from .verify import RlcReport, rlc_verify
 
 __all__ = [
     "PartialSignatures",
+    "RlcReport",
     "aggregate",
     "aggregate_host",
     "hash_to_curve_batch",
@@ -39,6 +46,7 @@ __all__ = [
     "partial_sign",
     "partial_sign_host",
     "public_keys",
+    "rlc_verify",
     "signature_encode",
     "verify_partials",
 ]

@@ -75,6 +75,10 @@ class PartialSignatures:
     sigs: np.ndarray  # (B, m, C, L) canonical affine limbs
     pks: list  # host pk_i tuples, len m
     proofs: list[DleqZkp] | None = None  # row-major over (B, m)
+    # (a1, a2) host announcement pairs matching ``proofs`` row-major;
+    # carried so sign.verify.rlc_verify can group-check z against them
+    # instead of recomputing announcements per cell
+    announcements: list[tuple] | None = None
 
     def sigs_host(self) -> list[list[tuple]]:
         """Host point tuples, [message][signer]."""
@@ -171,7 +175,9 @@ def partial_sign(
                 statements.append(
                     (g, h_points[bi], pks[si], sigs_host[bi][si], shares[si])
                 )
-        ps.proofs = dleq_batch.generate_batch(group, cs, statements, rng)
+        ps.proofs, ps.announcements = dleq_batch.generate_batch(
+            group, cs, statements, rng, return_announcements=True
+        )
     return ps
 
 

@@ -1,0 +1,216 @@
+"""RLC-combined partial-signature verification with bisecting blame.
+
+``verify_partials`` (sign.partial) answers "is every cell good?" by
+recomputing announcements for the whole grid — one batched MSM sized
+2·(B·m).  This module answers the *serving* question: accept the whole
+grid with ONE random-linear-combination check, and when it fails, find
+the exact bad (message, signer) cells in O(log) further checks instead
+of aborting the signing call (ROADMAP item: RLC batch verification
+with bisect-on-failure as the blame primitive).
+
+The check: each DLEQ cell i claims, with announcements (A1_i, A2_i)
+carried from proving time (``PartialSignatures.announcements``),
+
+    z_i·g    - e_i·pk_i  - A1_i == 0
+    z_i·H_i  - e_i·sig_i - A2_i == 0 .
+
+Drawing fresh random weights (u_i, v_i) per check, the combined sum
+
+    (Σ u_i·z_i)·g + Σ [ -u_i·e_i·pk_i - u_i·A1_i
+                        + v_i·z_i·H_i - v_i·e_i·sig_i - v_i·A2_i ]
+
+is the identity iff every cell holds, except with probability ~k/q for
+adversarially chosen bad cells (Schwartz–Zippel over the weights —
+weights MUST be unpredictable to the prover, hence drawn after the
+partials arrive).  The g terms collapse to one scalar, so a k-cell
+check is one (5k+1)-point MSM.
+
+Two stages before any MSM:
+
+1. *hash screen* — recompute each cell's Fiat-Shamir challenge from the
+   carried announcements.  e binds (g, H, pk, sig, A1, A2), so a
+   tampered signature / public key / announcement fails HERE at pure
+   host-hash cost and is blamed without a single group operation.  Only
+   a tampered *response* z survives the screen (z is not hashed), which
+   is exactly what the group check catches.
+2. *RLC accept-all* — one combined check over the screen's survivors;
+   the overwhelmingly common all-honest grid pays exactly one pass.
+
+On failure, blame runs a per-bad-cell binary search: bisect into the
+failing half (checking only the left half — if it passes, the bad cell
+is on the right), remove the found cell, re-run accept-all, repeat.
+Each bad cell costs ≤ ceil(log2(k)) + 1 extra passes (the search plus
+the failing accept-all that triggered it), the bound the service storm
+gates (scripts/service_storm.py, perf_regress.py).
+
+Dispatch: ``host`` (default) folds the MSM with big-int arithmetic —
+sign grids are (t+1)·B cells, tiny, and a host fold never compiles, so
+the serving path stays off the jit cache.  ``device`` runs the padded
+MSM kernel (``DKG_TPU_SIGN_RLC_DISPATCH``, validated via
+utils.envknobs; tested behind the ``slow`` tier).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..crypto.dleq import _challenge
+from ..fields import host as fh
+from ..groups import device as gd
+from ..groups import host as gh
+from ..utils import envknobs
+from .partial import PartialSignatures
+
+
+def _rlc_dispatch(dispatch: str | None) -> str:
+    """host|device: explicit argument wins, then the validated
+    DKG_TPU_SIGN_RLC_DISPATCH knob, then host (no-compile default)."""
+    if dispatch is not None:
+        if dispatch not in ("host", "device"):
+            raise ValueError(f"rlc dispatch must be host|device, got {dispatch!r}")
+        return dispatch
+    return (
+        envknobs.choice(
+            "DKG_TPU_SIGN_RLC_DISPATCH", ("host", "device"), "RLC combine leg"
+        )
+        or "host"
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class RlcReport:
+    """One rlc_verify outcome.
+
+    ``bad_cells``: (message, signer) grid positions (positions into
+    ``ps.h_points`` x ``ps.indices``) that failed, sorted row-major.
+    ``passes``: group-level RLC checks performed (1 for an all-honest
+    grid); hash-screen failures cost no passes.  ``grid``: total cells.
+    """
+
+    ok: bool
+    bad_cells: tuple[tuple[int, int], ...]
+    passes: int
+    grid: int
+
+    def pass_bound(self) -> int:
+        """The gated ceiling: 1 accept-all pass plus
+        ceil(log2(grid)) + 1 extra per bad group-detected cell."""
+        logk = max(1, self.grid - 1).bit_length()
+        return 1 + len(self.bad_cells) * (logk + 1)
+
+
+def _cell_rows(ps: PartialSignatures) -> list[tuple]:
+    """Per-cell verification data, row-major over (B, m):
+    (e, z, h, pk, sig, a1, a2) with host point tuples."""
+    group = gh.ALL_GROUPS[ps.curve]
+    g = group.generator()
+    b, m = ps.sigs.shape[:2]
+    sigs_host = ps.sigs_host()
+    rows = []
+    for bi in range(b):
+        for si in range(m):
+            p = ps.proofs[bi * m + si]
+            a1, a2 = ps.announcements[bi * m + si]
+            rows.append(
+                (p.challenge, p.response, ps.h_points[bi],
+                 ps.pks[si], sigs_host[bi][si], a1, a2, g)
+            )
+    return rows
+
+
+def _combine(group: gh.HostGroup, rows: list[tuple], rng) -> tuple[list, list]:
+    """The RLC combine's (scalars, points), g terms collapsed."""
+    q = group.scalar_field.modulus
+    g = rows[0][7]
+    g_acc = 0
+    scalars: list[int] = []
+    points: list = []
+    for e, z, h, pk, sig, a1, a2, _ in rows:
+        u = rng.randrange(1, q)
+        v = rng.randrange(1, q)
+        g_acc = (g_acc + u * z) % q
+        scalars.extend(
+            [(q - u * e % q) % q, q - u, v * z % q, (q - v * e % q) % q, q - v]
+        )
+        points.extend([pk, a1, h, sig, a2])
+    scalars.append(g_acc)
+    points.append(g)
+    return scalars, points
+
+
+def _rlc_check(
+    group: gh.HostGroup, cs, rows: list[tuple], rng, dispatch: str
+) -> bool:
+    """One combined check over ``rows``; True iff the sum is identity."""
+    scalars, points = _combine(group, rows, rng)
+    if dispatch == "host":
+        return group.is_identity(group.msm(scalars, points))
+    pts = gd.from_host(cs, points)  # (5k+1, C, L)
+    sc = jnp.asarray(fh.encode(cs.scalar, scalars))  # (5k+1, L)
+    acc = gd.msm(cs, sc, pts)
+    (host_pt,) = gd.to_host(cs, np.asarray(acc)[None])
+    return group.is_identity(host_pt)
+
+
+def rlc_verify(
+    ps: PartialSignatures,
+    *,
+    rng=None,
+    dispatch: str | None = None,
+) -> RlcReport:
+    """Accept-all-or-blame verification of a proved partial grid.
+
+    ``rng`` draws the RLC weights (default SystemRandom — they must be
+    unpredictable to the signers; seed only in tests/benchmarks).
+    Requires proofs AND announcements (``partial_sign(prove=True)``).
+    """
+    if ps.proofs is None or ps.announcements is None:
+        raise ValueError(
+            "rlc_verify needs proofs and announcements "
+            "(partial_sign(..., prove=True))"
+        )
+    group = gh.ALL_GROUPS[ps.curve]
+    cs = gd.ALL_CURVES[ps.curve]
+    mode = _rlc_dispatch(dispatch)
+    if rng is None:
+        rng = random.SystemRandom()
+    b, m = ps.sigs.shape[:2]
+    rows = _cell_rows(ps)
+    cells = [(bi, si) for bi in range(b) for si in range(m)]
+    # stage 1: hash screen — e binds everything except z
+    live: list[int] = []
+    bad: list[tuple[int, int]] = []
+    for i, (e, _z, h, pk, sig, a1, a2, g) in enumerate(rows):
+        if e == _challenge(group, g, h, pk, sig, a1, a2):
+            live.append(i)
+        else:
+            bad.append(cells[i])
+    # stage 2/3: accept-all, binary-search one bad cell per failure
+    passes = 0
+    while live:
+        passes += 1
+        if _rlc_check(group, cs, [rows[i] for i in live], rng, mode):
+            break
+        lo, hi = 0, len(live)  # live[lo:hi] contains >= 1 bad cell
+        while hi - lo > 1:
+            mid = (lo + hi) // 2
+            passes += 1
+            if _rlc_check(
+                group, cs, [rows[i] for i in live[lo:mid]], rng, mode
+            ):
+                lo = mid  # left half clean -> culprit on the right
+            else:
+                hi = mid
+        bad.append(cells[live[lo]])
+        del live[lo]
+    return RlcReport(
+        ok=not bad,
+        bad_cells=tuple(sorted(bad)),
+        passes=passes,
+        grid=b * m,
+    )

@@ -23,9 +23,13 @@ DKG_TPU_DIGEST via crypto.device_hash.digest_dispatch,
 DKG_TPU_OBSLOG flight-recorder log directory via utils.obslog,
 DKG_TPU_SERVICE_CONCURRENCY / DKG_TPU_SERVICE_QUEUE_DEPTH /
 DKG_TPU_SERVICE_BATCH_MAX / DKG_TPU_SERVICE_DEADLINE_S /
-DKG_TPU_SERVICE_WAL_DIR scheduler knobs via service.scheduler —
-lint rule DKG007 bans any other environment access in
-dkg_tpu/service/,
+DKG_TPU_SERVICE_WAL_DIR / DKG_TPU_SERVICE_RETRIES (transient-fault
+convoy retries, 0 disables) / DKG_TPU_SERVICE_RETRY_BACKOFF_S (first
+backoff, doubling) / DKG_TPU_SERVICE_MAX_REPLAYS (journal crash-loop
+guard) scheduler knobs via service.scheduler — lint rule DKG007 bans
+any other environment access in dkg_tpu/service/,
+DKG_TPU_SIGN_RLC_DISPATCH (host|device RLC combine leg) via
+sign.verify,
 DKG_TPU_EPOCH_MAX_CHURN (leave+join budget a reshare accepts; 0
 refuses any membership change) and DKG_TPU_EPOCH_DEADLINE_S
 (per-epoch-round fetch timeout) via dkg_tpu.epoch.manager — lint

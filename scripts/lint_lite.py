@@ -82,6 +82,18 @@ linter), so the committed baseline stays clean between CI runs:
         allowlisted by name suffix — or a raw ``os.environ`` /
         ``os.getenv`` read: signing knobs (DKG_TPU_SIGN_*) go through
         ``utils.envknobs`` (docs/signing.md)
+* DKG010  (dkg_tpu/service/ and dkg_tpu/sign/ only) silent failure
+        handling on the serving path: an ``except Exception`` handler
+        whose body neither re-raises nor records the failure (a metric
+        ``inc``/``observe``/``set_gauge``, an obslog ``emit*``, or one
+        of the scheduler's containment entry points — see
+        ``_DKG010_RECORDERS``) swallows a fault the blast-radius
+        machinery exists to account for; and a literal
+        ``raise RuntimeError`` — failures there must use the typed
+        taxonomy in ``service/errors.py`` (PoisonedRequest,
+        TransientEngineError, …) so callers and the isolation logic can
+        branch on type, never on message text (docs/fault_model.md
+        "Service fault model")
 
 Exit 0 = clean.  Run: ``python scripts/lint_lite.py`` (from repo root).
 Also executed by tests/test_import_hygiene.py so the default test tier
@@ -176,6 +188,28 @@ _SERVICE_SPAWN_OWNER = "scheduler.py"
 # pair is the O(n^2) pathology the batched kernels exist to avoid.
 # (Batched gd.scalar_mul over stacked rows sits OUTSIDE any loop.)
 _EPOCH_SCALAR_MULS = {"scalar_mul", "scalar_mul_vartime"}
+
+# Calls that count as "recording the failure" inside an
+# ``except Exception`` handler on the serving path (DKG010): metric
+# writes, flight-recorder emits, and the scheduler's containment entry
+# points (each of which metrics+emits internally).  A handler that does
+# none of these and does not re-raise is swallowing a fault silently.
+_DKG010_RECORDERS = {
+    "inc",
+    "observe",
+    "set_gauge",
+    "emit",
+    "emit_current",
+    "emit_span",
+    "_emit",
+    "_isolate",
+    "_fail_convoy",
+    "_poison_one",
+    "_retry_transient",
+    "_note",
+    "record_done",
+    "_finish_one",
+}
 
 # The same entry points banned inside loops in dkg_tpu/sign/ (DKG009):
 # a host scalar_mul per (message, signer) pair is the B·(t+1) pathology
@@ -301,6 +335,58 @@ class _Checker(ast.NodeVisitor):
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
         if node.type is None:
             self._add(node, "E722", "bare except")
+        # DKG010a: serving-path code may catch Exception ONLY to
+        # account for it — the handler body must re-raise or hit a
+        # recorder (metric / obslog / containment entry point) so no
+        # fault disappears without a metric and an event.
+        if (
+            (self._service_module or self._sign_module)
+            and isinstance(node.type, ast.Name)
+            and node.type.id == "Exception"
+        ):
+            recorded = False
+            for sub in node.body:
+                for inner in ast.walk(sub):
+                    if isinstance(inner, ast.Raise):
+                        recorded = True
+                    elif isinstance(inner, ast.Call):
+                        f = inner.func
+                        fname = f.attr if isinstance(f, ast.Attribute) else (
+                            f.id if isinstance(f, ast.Name) else ""
+                        )
+                        if fname in _DKG010_RECORDERS:
+                            recorded = True
+            if not recorded:
+                self._add(
+                    node,
+                    "DKG010",
+                    "except Exception swallowed without recording in "
+                    "dkg_tpu/service|sign/ — re-raise or record the "
+                    "failure (metrics.inc / obslog emit / a containment "
+                    "entry point) before continuing",
+                )
+        self.generic_visit(node)
+
+    def visit_Raise(self, node: ast.Raise) -> None:
+        # DKG010b: the serving path's failure taxonomy is typed
+        # (service/errors.py) — a bare RuntimeError gives the isolation
+        # machinery and callers nothing to branch on.
+        if self._service_module or self._sign_module:
+            exc = node.exc
+            name = ""
+            if isinstance(exc, ast.Call) and isinstance(exc.func, ast.Name):
+                name = exc.func.id
+            elif isinstance(exc, ast.Name):
+                name = exc.id
+            if name == "RuntimeError":
+                self._add(
+                    node,
+                    "DKG010",
+                    "raise RuntimeError in dkg_tpu/service|sign/ — raise a "
+                    "typed error from service/errors.py instead "
+                    "(PoisonedRequest, TransientEngineError, "
+                    "InsufficientSigners, …)",
+                )
         self.generic_visit(node)
 
     def visit_JoinedStr(self, node: ast.JoinedStr) -> None:

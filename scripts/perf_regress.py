@@ -54,6 +54,17 @@ host-side Fiat-Shamir hashing and single-dispatch MSM noise).  Shapes
 present in only one round, or rounds from different platforms, skip
 with a note.
 
+The service chaos storm: ``SVCSTORM_r{NN}.json`` rounds
+(scripts/service_storm.py) gate FLOORS on the newest round rather than
+a newest-two diff — resilience is an invariant, not a rate.  FAIL when
+the newest storm round shows ``survival_rate`` < 1.0 (a healthy request
+was harmed by someone else's fault), a healthy master that was not
+bit-identical to the fault-free reference leg, a poisoned request
+without a typed ``PoisonedRequest`` outcome, blame accuracy < 1.0
+(convoy bisection or signing RLC blame fingered the wrong culprit), or
+a signing blame pass count above the ceil(log2 grid)+1-per-bad-cell
+bound.  No storm rounds on disk skips with a note.
+
 Run: ``python scripts/perf_regress.py [--threshold 0.2] [dir]``.
 """
 
@@ -69,6 +80,7 @@ _PAT = re.compile(r"BENCH_r(\d+)\.json$")
 _FLEET_PAT = re.compile(r"FLEET_r(\d+)\.json$")
 _EPOCH_PAT = re.compile(r"EPOCH_r(\d+)\.json$")
 _SIGN_PAT = re.compile(r"SIGN_r(\d+)\.json$")
+_SVCSTORM_PAT = re.compile(r"SVCSTORM_r(\d+)\.json$")
 
 
 def _load_rounds(root: pathlib.Path) -> list[tuple[int, dict]]:
@@ -114,6 +126,7 @@ def main(argv: list[str] | None = None) -> int:
         fleet_gate(root, args.threshold)
         or epoch_gate(root, args.threshold)
         or sign_gate(root, args.threshold)
+        or svcstorm_gate(root)
     )
 
     rounds = _load_rounds(root)
@@ -422,6 +435,100 @@ def sign_gate(root: pathlib.Path, threshold: float) -> int:
         print(
             f"perf_regress: sign r{old_n} and r{new_n} share no usable "
             "shapes — nothing to diff"
+        )
+    return bad
+
+
+def _load_svcstorm_rounds(root: pathlib.Path) -> list[tuple[int, dict]]:
+    """(round number, storm report) for every usable storm round,
+    ascending — usable means the convoy leg ran a positive number of
+    requests (an infra-dead round skips rather than blocks)."""
+    out: list[tuple[int, dict]] = []
+    for path in sorted(root.glob("SVCSTORM_r*.json")):
+        m = _SVCSTORM_PAT.search(path.name)
+        if not m:
+            continue
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError):
+            continue
+        convoy = (doc.get("convoy") or {}) if isinstance(doc, dict) else {}
+        reqs = convoy.get("requests")
+        if not isinstance(reqs, int) or reqs <= 0:
+            continue
+        out.append((int(m.group(1)), doc))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+def svcstorm_gate(root: pathlib.Path) -> int:
+    """Floor-check the NEWEST storm round (no diff: resilience is an
+    invariant, not a rate).  Survival, bit-identity, typed poisoning,
+    and blame accuracy must all be perfect; signing blame must stay
+    within its logarithmic pass bound."""
+    rounds = _load_svcstorm_rounds(root)
+    if not rounds:
+        print(f"perf_regress: no usable storm round in {root} — skipping")
+        return 0
+    new_n, doc = rounds[-1]
+    convoy = doc.get("convoy") or {}
+    sign = doc.get("sign") or {}
+    bad = 0
+
+    def floor(label: str, ok: bool, detail: str) -> None:
+        nonlocal bad
+        line = f"perf_regress: storm r{new_n} {label}: {detail}"
+        if ok:
+            print(line)
+        else:
+            print(f"{line} — RESILIENCE FLOOR VIOLATED", file=sys.stderr)
+            bad = 1
+
+    survival = convoy.get("survival_rate")
+    floor(
+        "survival_rate",
+        survival == 1.0,
+        f"{survival!r} over {convoy.get('requests')} requests",
+    )
+    healthy = convoy.get("healthy")
+    identical = convoy.get("healthy_bit_identical")
+    floor(
+        "healthy bit-identity",
+        isinstance(healthy, int) and identical == healthy,
+        f"{identical!r}/{healthy!r} masters match the fault-free leg",
+    )
+    poisoned = convoy.get("poisoned")
+    typed = convoy.get("poisoned_typed")
+    floor(
+        "typed poisoning",
+        isinstance(poisoned, int) and typed == poisoned,
+        f"{typed!r}/{poisoned!r} poisoned requests got PoisonedRequest",
+    )
+    blame = convoy.get("blame_accuracy")
+    floor("blame accuracy", blame == 1.0, f"{blame!r}")
+    if sign:
+        floor(
+            "sign blame cells",
+            bool(sign.get("blamed_cells_exact")),
+            f"exact={sign.get('blamed_cells_exact')!r}",
+        )
+        passes, bound = sign.get("passes"), sign.get("pass_bound")
+        floor(
+            "sign pass bound",
+            isinstance(passes, int)
+            and isinstance(bound, int)
+            and passes <= bound,
+            f"{passes!r} passes vs bound {bound!r}",
+        )
+        floor(
+            "sign substitute signature",
+            bool(sign.get("substitute_sig_bit_identical")),
+            f"bit_identical={sign.get('substitute_sig_bit_identical')!r}",
+        )
+    else:
+        print(
+            f"perf_regress: storm r{new_n} has no sign leg — convoy "
+            "floors only"
         )
     return bad
 

@@ -142,6 +142,70 @@ def test_lint_dkg007_bans_raw_config_and_spawns_in_service():
     assert codes_for("scripts/tool.py") == []
 
 
+def test_lint_dkg010_bans_silent_swallows_and_bare_runtimeerror():
+    """DKG010: serving-path code (dkg_tpu/service/ and dkg_tpu/sign/)
+    may catch Exception only if the handler re-raises or records the
+    failure, and must raise the typed taxonomy instead of a bare
+    RuntimeError.  The rule is scoped — the same source elsewhere is
+    clean."""
+    import ast
+    import pathlib
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "scripts"))
+    try:
+        import lint_lite
+    finally:
+        sys.path.pop(0)
+
+    src = (
+        "def swallow():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        result = None\n"
+        "def recorded(metrics):\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception as exc:\n"
+        "        metrics.inc('service_failed_total')\n"
+        "def reraised():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        raise\n"
+        "def contained(self, convoy, exc, t0):\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception as exc:\n"
+        "        self._isolate(convoy, exc, t0)\n"
+        "def typed_only():\n"
+        "    raise RuntimeError('use errors.PoisonedRequest instead')\n"
+        "def narrow():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except ValueError:\n"  # narrow catches are out of scope
+        "        pass\n"
+    )
+    tree = ast.parse(src)
+
+    def codes_for(path: str) -> list:
+        return [
+            c
+            for _, c, _ in lint_lite._Checker(
+                pathlib.Path(path), tree, src
+            ).finish()
+            if c == "DKG010"
+        ]
+
+    # one silent swallow + one bare RuntimeError = 2 findings, in both
+    # serving-path packages
+    assert len(codes_for("dkg_tpu/service/evil.py")) == 2
+    assert len(codes_for("dkg_tpu/sign/evil.py")) == 2
+    # the rule is serving-path-scoped
+    assert codes_for("dkg_tpu/dkg/elsewhere.py") == []
+    assert codes_for("tests/test_x.py") == []
+
+
 def test_hostmesh_import_is_lightweight():
     # The driver image's sitecustomize preloads jax itself, so "jax not
     # in sys.modules" is unattainable; assert the real invariants: no

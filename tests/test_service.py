@@ -31,6 +31,7 @@ from dkg_tpu.service import buckets, engine
 from dkg_tpu.service import scheduler as scheduler_mod
 from dkg_tpu.service.durable import ServiceJournal
 from dkg_tpu.service.engine import CeremonyOutcome, CeremonyRequest
+from dkg_tpu.service.faultsvc import ServiceFaultPlan
 from dkg_tpu.service.scheduler import CeremonyScheduler, QueueFullError
 from dkg_tpu.utils.metrics import MetricsRegistry
 
@@ -163,8 +164,8 @@ def test_journal_replay_partitions_pending_and_terminal(tmp_path):
             qualified=(True,) * 5, complaints=((2, 1),),
         )
     )
-    pending, terminal = j.replay()
-    assert set(pending) == {"cid2"}
+    pending, terminal, replays = j.replay()
+    assert set(pending) == {"cid2"} and replays == {}
     seq, req = pending["cid2"]
     assert seq == 1
     assert (req.curve, req.n, req.t, req.seed) == (CURVE, 6, 2, 12)
@@ -180,11 +181,11 @@ def test_journal_skips_unparseable_bodies_and_compacts(tmp_path):
     j.record_request("cid1", 0, CeremonyRequest(CURVE, 5, 2, seed=1, durable=True))
     j.wal.append(b"not json {")  # version skew, not corruption
     j.wal.append(json.dumps({"no": "kind"}).encode())
-    pending, terminal = j.replay()
+    pending, terminal, replays = j.replay()
     assert set(pending) == {"cid1"} and not terminal
-    j.compact(pending, terminal)
+    j.compact(pending, terminal, replays)
     # compacted journal replays to the identical state, junk dropped
-    pending2, terminal2 = ServiceJournal(tmp_path).replay()
+    pending2, terminal2, _ = ServiceJournal(tmp_path).replay()
     assert set(pending2) == {"cid1"} and not terminal2
     assert pending2["cid1"][1] == pending["cid1"][1]
 
@@ -393,6 +394,270 @@ def test_scheduler_reads_envknobs(monkeypatch, fake_engine):
     monkeypatch.setenv("DKG_TPU_SERVICE_QUEUE_DEPTH", "zero")
     with pytest.raises(ValueError):
         CeremonyScheduler(runtime=object())
+
+
+def test_scheduler_reads_resilience_envknobs(monkeypatch, fake_engine):
+    monkeypatch.delenv("DKG_TPU_SERVICE_WAL_DIR", raising=False)
+    monkeypatch.setenv("DKG_TPU_SERVICE_RETRIES", "0")
+    monkeypatch.setenv("DKG_TPU_SERVICE_RETRY_BACKOFF_S", "0.25")
+    monkeypatch.setenv("DKG_TPU_SERVICE_MAX_REPLAYS", "7")
+    sch = CeremonyScheduler(concurrency=1, runtime=object())
+    try:
+        assert sch.retries == 0, "0 disables transient retries"
+        assert sch.retry_backoff_s == 0.25
+        assert sch.max_replays == 7
+    finally:
+        fake_engine.gate.set()
+        sch.close()
+    for name, bad in (
+        ("DKG_TPU_SERVICE_RETRIES", "-1"),
+        ("DKG_TPU_SERVICE_RETRY_BACKOFF_S", "fast"),
+        ("DKG_TPU_SERVICE_MAX_REPLAYS", "0"),
+    ):
+        monkeypatch.setenv(name, bad)
+        with pytest.raises(ValueError, match=name):
+            CeremonyScheduler(concurrency=1, runtime=object())
+        monkeypatch.delenv(name)
+
+
+# ---------------------------------------------------------------------------
+# blast-radius isolation, watchdog, crash-loop guard (engine monkeypatched)
+# ---------------------------------------------------------------------------
+
+
+def test_poison_bisection_isolates_one_request_at_width_4(fake_engine):
+    """A width-4 convoy with one poisoned member: the three healthy
+    requests complete exactly as a fault-free run would, and only the
+    culprit — found by bisecting down the width ladder — ends poisoned."""
+    reg = MetricsRegistry()
+    plan = ServiceFaultPlan(seed=1).poison("bad")
+    sch = CeremonyScheduler(
+        concurrency=1, queue_depth=16, batch_max=8, runtime=object(),
+        metrics=reg, fault_plan=plan,
+    )
+    try:
+        held = sch.submit(CeremonyRequest(CURVE, 5, 2, seed=0, rho_bits=32))
+        _wait_status(sch, held, "running")  # park so a width-4 convoy forms
+        ids = [
+            sch.submit(
+                CeremonyRequest(
+                    CURVE, 5, 2, seed=10 + i,
+                    tag="bad" if i == 2 else f"ok{i}",
+                )
+            )
+            for i in range(4)
+        ]
+    finally:
+        fake_engine.gate.set()
+    outs = [sch.result(i, timeout=10) for i in ids]
+    sch.close()
+    for i, out in enumerate(outs):
+        if i == 2:
+            assert out.status == "poisoned"
+            assert out.error.startswith("PoisonedRequest: PoisonFault")
+        else:
+            assert out.status == "done"
+            assert out.master == b"M:" + ids[i].encode()
+    snap = reg.snapshot()["counters"]
+    assert snap["service_poisoned_total"] == 1
+    # width 4 -> halves (2, 2) -> the bad half -> (1, 1): two bisections
+    assert snap["service_convoy_bisections_total"] == 2
+    # the poison refired at widths 4, 2, and 1 — deterministic chaos
+    assert plan.injected["poison"] == 3
+
+
+def test_transient_fault_retries_and_recovers(fake_engine):
+    reg = MetricsRegistry()
+    plan = ServiceFaultPlan().transient(times=1)
+    sch = CeremonyScheduler(
+        concurrency=1, queue_depth=8, batch_max=1, runtime=object(),
+        metrics=reg, fault_plan=plan, retries=2, retry_backoff_s=0.0,
+    )
+    fake_engine.gate.set()
+    cid = sch.submit(CeremonyRequest(CURVE, 5, 2, seed=0))
+    out = sch.result(cid, timeout=10)
+    sch.close()
+    assert out.status == "done" and out.master == b"M:" + cid.encode()
+    snap = reg.snapshot()["counters"]
+    assert snap["service_retries_total"] == 1
+    assert "service_poisoned_total" not in snap
+    assert "service_convoy_bisections_total" not in snap
+
+
+def test_transient_retries_exhausted_fail_typed(fake_engine):
+    reg = MetricsRegistry()
+    plan = ServiceFaultPlan().transient(times=10)
+    sch = CeremonyScheduler(
+        concurrency=1, queue_depth=8, batch_max=1, runtime=object(),
+        metrics=reg, fault_plan=plan, retries=1, retry_backoff_s=0.0,
+    )
+    fake_engine.gate.set()
+    cid = sch.submit(CeremonyRequest(CURVE, 5, 2, seed=0))
+    out = sch.result(cid, timeout=10)
+    sch.close()
+    assert out.status == "failed"
+    assert out.error.startswith("TransientEngineError")
+    snap = reg.snapshot()["counters"]
+    assert snap["service_retries_total"] == 1
+    assert snap['service_failed_total{kind="TransientEngineError"}'] == 1
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_watchdog_respawns_crashed_worker_and_requeues(fake_engine):
+    """A WorkerCrash (BaseException) kills the worker THREAD; the
+    watchdog respawns it and re-queues the orphaned convoy, which then
+    completes normally."""
+    reg = MetricsRegistry()
+    plan = ServiceFaultPlan().crash_worker(at_start=1)
+    sch = CeremonyScheduler(
+        concurrency=1, queue_depth=8, batch_max=1, runtime=object(),
+        metrics=reg, fault_plan=plan, watchdog_interval_s=0.05,
+    )
+    fake_engine.gate.set()
+    cid = sch.submit(CeremonyRequest(CURVE, 5, 2, seed=0))
+    out = sch.result(cid, timeout=10)
+    sch.close()
+    assert out.status == "done" and out.master == b"M:" + cid.encode()
+    snap = reg.snapshot()["counters"]
+    assert snap["service_worker_restarts_total"] >= 1
+    assert snap["service_requeued_total"] == 1
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_repeated_worker_crashes_fail_the_request_typed(fake_engine):
+    """A request whose convoy kills its worker TWICE is treated as the
+    probable culprit: failed with WORKER_CRASH instead of crash-looping
+    the pool forever."""
+    reg = MetricsRegistry()
+    plan = ServiceFaultPlan().crash_worker(at_start=1).crash_worker(at_start=2)
+    sch = CeremonyScheduler(
+        concurrency=1, queue_depth=8, batch_max=1, runtime=object(),
+        metrics=reg, fault_plan=plan, watchdog_interval_s=0.05,
+    )
+    fake_engine.gate.set()
+    cid = sch.submit(CeremonyRequest(CURVE, 5, 2, seed=0))
+    out = sch.result(cid, timeout=10)
+    sch.close()
+    assert out.status == "failed"
+    assert "WORKER_CRASH" in out.error
+    snap = reg.snapshot()["counters"]
+    assert snap["service_worker_restarts_total"] >= 2
+    assert snap['service_failed_total{kind="WORKER_CRASH"}'] == 1
+
+
+def test_crash_loop_guard_counts_replays_and_poisons(tmp_path, fake_engine):
+    reg = MetricsRegistry()
+    j = ServiceJournal(tmp_path)
+    j.record_request(
+        "cidR", 0, CeremonyRequest(CURVE, 5, 2, seed=31, durable=True)
+    )
+    fake_engine.gate.set()
+    sch = CeremonyScheduler(
+        concurrency=1, queue_depth=8, batch_max=1,
+        wal_dir=str(tmp_path), runtime=object(), metrics=reg,
+    )
+    assert sch.result("cidR", timeout=10).status == "done"
+    sch.close()
+    # the recovery stamped replay #1 into the WAL before re-queueing:
+    # the crash-loop guard's memory of this attempt survives compaction
+    _, terminal, replays = ServiceJournal(tmp_path).replay()
+    assert "cidR" in terminal and replays == {"cidR": 1}
+
+    # a request that already burned max_replays recoveries is the likely
+    # CAUSE of those crashes: the next recovery poisons it instead of
+    # queueing it for another round of taking the process down
+    j2 = ServiceJournal(tmp_path)
+    j2.record_request(
+        "cidP", 1, CeremonyRequest(CURVE, 5, 2, seed=32, durable=True)
+    )
+    for count in (1, 2, 3):
+        j2.record_replay("cidP", count)
+    reg2 = MetricsRegistry()
+    sch2 = CeremonyScheduler(
+        concurrency=1, queue_depth=8, batch_max=1,
+        wal_dir=str(tmp_path), runtime=object(), metrics=reg2,
+        max_replays=3,
+    )
+    assert sch2.poll("cidP") == "poisoned"
+    out = sch2.result("cidP")
+    assert out.error.startswith("PoisonedRequest") and "REPLAY_LIMIT" in out.error
+    assert reg2.snapshot()["counters"]["service_poisoned_total"] == 1
+    sch2.close()
+
+    # the poisoned verdict is itself journalled: the NEXT recovery
+    # re-serves it terminally without another replay round
+    sch3 = CeremonyScheduler(
+        concurrency=1, queue_depth=8, batch_max=1,
+        wal_dir=str(tmp_path), runtime=object(), max_replays=3,
+    )
+    assert sch3.poll("cidP") == "poisoned"
+    sch3.close()
+
+
+def test_failure_paths_emit_kind_only_never_payloads(
+    tmp_path, fake_engine, monkeypatch
+):
+    """The obslog redaction contract for the service failure paths:
+    reject/expire/poison events carry the error KIND and ceremony id,
+    never the exception message (which may embed share or seed
+    material).  The caller-facing outcome keeps the full error."""
+    from dkg_tpu.utils.obslog import ObsLog
+
+    canary = "5ecret-c4nary-d34db33f"
+    log = ObsLog(path=tmp_path / "svc.jsonl")
+    reg = MetricsRegistry()
+
+    # leg 1 (fake engine): backpressure reject + queued-deadline expiry
+    sch = CeremonyScheduler(
+        concurrency=1, queue_depth=1, batch_max=1, runtime=object(),
+        metrics=reg, log=log,
+    )
+    held = sch.submit(CeremonyRequest(CURVE, 5, 2, seed=0))
+    _wait_status(sch, held, "running")
+    doomed = sch.submit(
+        CeremonyRequest(CURVE, 5, 2, seed=1, deadline_s=0.01)
+    )
+    with pytest.raises(QueueFullError):
+        sch.submit(CeremonyRequest(CURVE, 5, 2, seed=2))
+    time.sleep(0.05)
+    fake_engine.gate.set()
+    assert sch.result(doomed, timeout=10).status == "expired"
+    sch.close()
+
+    # leg 2: an engine exploding with secret-bearing text -> poisoned
+    def _bomb(runtime, reqs, ids=None):
+        raise RuntimeError(f"engine exploded holding {canary}")
+
+    monkeypatch.setattr(scheduler_mod, "start_convoy", _bomb)
+    sch2 = CeremonyScheduler(
+        concurrency=1, queue_depth=4, batch_max=1, runtime=object(),
+        metrics=reg, log=log,
+    )
+    cid = sch2.submit(CeremonyRequest(CURVE, 5, 2, seed=3))
+    out = sch2.result(cid, timeout=10)
+    sch2.close()
+    assert out.status == "poisoned"
+    assert canary in out.error, "the CALLER gets the full error"
+
+    log.close()
+    raw = (tmp_path / "svc.jsonl").read_text()
+    assert canary not in raw, "the obslog stream must never see payloads"
+    events = [json.loads(line) for line in raw.splitlines()]
+    kinds = {e["kind"] for e in events}
+    assert {"service_rejected", "service_expired", "service_poisoned"} <= kinds
+    rej = next(e for e in events if e["kind"] == "service_rejected")
+    assert rej["error_kind"] == "QUEUE_FULL"
+    pois = next(e for e in events if e["kind"] == "service_poisoned")
+    assert pois["error_kind"] == "RuntimeError" and pois["ceremony"] == cid
+    # each failure path owns a DISTINCT metric series
+    snap = reg.snapshot()["counters"]
+    assert snap["service_rejected_total"] == 1
+    assert snap['service_expired_total{where="queued"}'] == 1
+    assert snap["service_poisoned_total"] == 1
 
 
 # ---------------------------------------------------------------------------

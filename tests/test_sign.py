@@ -206,6 +206,108 @@ def test_verify_partials_requires_proofs():
         sg.verify_partials(bare)
 
 
+# ------------------------------------------------- RLC verify + bisecting blame
+
+
+def _z_tampered(ps, bi, si):
+    """Forge cell (bi, si)'s DLEQ *response* — the one tamper that
+    survives the hash screen (z is not bound by e) and must be caught
+    by the group-level RLC check."""
+    group = gh.ALL_GROUPS[ps.curve]
+    q = group.scalar_field.modulus
+    m = len(ps.indices)
+    proofs = list(ps.proofs)
+    p = proofs[bi * m + si]
+    proofs[bi * m + si] = dataclasses.replace(
+        p, response=(p.response + 1) % q
+    )
+    return dataclasses.replace(ps, proofs=proofs)
+
+
+def test_rlc_verify_accepts_honest_grid_in_one_pass():
+    report = sg.rlc_verify(_ctx("secp256k1")["ps"], rng=random.Random(41))
+    assert report.ok
+    assert report.bad_cells == ()
+    assert report.passes == 1, "the all-honest grid pays exactly one check"
+    assert report.grid == len(MESSAGES) * (T + 1)
+
+
+def test_rlc_verify_bisects_blame_to_the_forged_response():
+    """A tampered z passes the hash screen but fails the combined group
+    check; the binary search lands on exactly that cell within the
+    ceil(log2 grid)+1 extra-pass budget the storm gates."""
+    forged = _z_tampered(_ctx("secp256k1")["ps"], 1, 2)
+    report = sg.rlc_verify(forged, rng=random.Random(42))
+    assert not report.ok
+    assert report.bad_cells == ((1, 2),)
+    # 1 failing accept-all + ceil(log2 6)=3 search passes + 1 clean
+    # accept-all over the survivors
+    assert report.passes == 5
+    assert report.passes <= report.pass_bound()
+
+
+def test_rlc_verify_blames_two_cells_within_the_pass_bound():
+    forged = _z_tampered(
+        _z_tampered(_ctx("secp256k1")["ps"], 0, 0), 1, 1
+    )
+    report = sg.rlc_verify(forged, rng=random.Random(43))
+    assert not report.ok
+    assert report.bad_cells == ((0, 0), (1, 1))
+    assert report.passes <= report.pass_bound()
+
+
+def test_rlc_verify_hash_screen_blames_forged_sig_for_free():
+    """A tampered signature point breaks the Fiat-Shamir binding, so
+    blame costs zero group passes beyond the survivors' accept-all."""
+    ps = _ctx("secp256k1")["ps"]
+    forged = dataclasses.replace(ps, sigs=ps.sigs.copy())
+    forged.sigs[1, 1] = ps.sigs[1, 0]
+    report = sg.rlc_verify(forged, rng=random.Random(44))
+    assert not report.ok
+    assert report.bad_cells == ((1, 1),)
+    assert report.passes == 1, "hash-screen blame costs no extra RLC passes"
+
+
+def test_rlc_verify_requires_proofs_and_announcements():
+    ps = _ctx("secp256k1")["ps"]
+    for stripped in (
+        dataclasses.replace(ps, proofs=None),
+        dataclasses.replace(ps, announcements=None),
+    ):
+        with pytest.raises(ValueError, match="announcements"):
+            sg.rlc_verify(stripped)
+
+
+def test_rlc_dispatch_knob(monkeypatch):
+    from dkg_tpu.sign import verify as sv
+
+    monkeypatch.delenv("DKG_TPU_SIGN_RLC_DISPATCH", raising=False)
+    assert sv._rlc_dispatch(None) == "host"
+    monkeypatch.setenv("DKG_TPU_SIGN_RLC_DISPATCH", "device")
+    assert sv._rlc_dispatch(None) == "device"
+    assert sv._rlc_dispatch("host") == "host", "explicit wins"
+    monkeypatch.setenv("DKG_TPU_SIGN_RLC_DISPATCH", "")
+    assert sv._rlc_dispatch(None) == "host", "empty value means unset"
+    monkeypatch.setenv("DKG_TPU_SIGN_RLC_DISPATCH", "tpu")
+    with pytest.raises(ValueError, match="DKG_TPU_SIGN_RLC_DISPATCH"):
+        sv._rlc_dispatch(None)
+    with pytest.raises(ValueError, match="host|device"):
+        sv._rlc_dispatch("tpu")
+
+
+@pytest.mark.slow
+def test_rlc_verify_device_dispatch_parity():
+    """The padded device MSM leg reaches the same verdicts as the
+    host big-int fold — clean grid and z-tamper blame alike."""
+    ps = _ctx("secp256k1")["ps"]
+    clean = sg.rlc_verify(ps, rng=random.Random(45), dispatch="device")
+    assert clean.ok and clean.passes == 1
+    forged = _z_tampered(ps, 0, 1)
+    report = sg.rlc_verify(forged, rng=random.Random(46), dispatch="device")
+    assert report.bad_cells == ((0, 1),)
+    assert report.passes <= report.pass_bound()
+
+
 # --------------------------------------------------------------- aggregation
 
 
@@ -372,6 +474,97 @@ def test_scheduler_sign_serves_signatures_with_metrics():
             sch._record(starved)
         with pytest.raises(ValueError, match="qualified signers"):
             sch.sign("starved", MESSAGES)
+    finally:
+        sch.close()
+
+
+def test_scheduler_sign_quarantines_byzantine_signer_and_resigns():
+    """One Byzantine signer forges a DLEQ response inside a t+1 quorum:
+    the RLC blame lands on exactly that signer, it joins the ceremony's
+    quarantine, and the transparent re-sign with a substitute quorum
+    emits bytes identical to the honest oracle (Lagrange-at-zero makes
+    substitution invisible).  A signer that keeps forging starves the
+    eligible set and surfaces as typed InsufficientSigners."""
+    from dkg_tpu.fields import host as fh
+    from dkg_tpu.service.errors import InsufficientSigners
+    from dkg_tpu.service.engine import CeremonyOutcome
+    from dkg_tpu.service.scheduler import CeremonyScheduler
+    from dkg_tpu.utils.metrics import MetricsRegistry
+
+    curve = "secp256k1"
+    ctx = _ctx(curve)
+    group = ctx["group"]
+    fs = group.scalar_field
+    q = fs.modulus
+
+    reg = MetricsRegistry()
+    sch = CeremonyScheduler(
+        concurrency=1, queue_depth=4, batch_max=1, runtime=object(),
+        metrics=reg,
+    )
+    try:
+        for cid in ("byz", "greedy"):
+            out = CeremonyOutcome(
+                ceremony_id=cid, status="done", curve=curve, n=N, t=T,
+                master=group.encode(
+                    group.scalar_mul_vartime(
+                        ctx["secret"], group.generator()
+                    )
+                ),
+                qualified=(True,) * N,
+                final_shares=np.asarray(fh.encode(fs, ctx["shares"])),
+            )
+            with sch._cond:
+                sch._record(out)
+
+        state = {"signer": None}
+
+        def forge_once(ps):
+            if state["signer"] is not None:
+                return ps
+            state["signer"] = ps.indices[1]
+            m = len(ps.indices)
+            proofs = list(ps.proofs)
+            p = proofs[0 * m + 1]  # cell (message 0, signer column 1)
+            proofs[0 * m + 1] = dataclasses.replace(
+                p, response=(p.response + 1) % q
+            )
+            return dataclasses.replace(ps, proofs=proofs)
+
+        sigs = sch.sign("byz", MESSAGES, seed=11, tamper=forge_once)
+        assert sigs == ctx["expected_sig"], (
+            "substitute quorum must encode the identical signature bytes"
+        )
+        assert sch.quarantined("byz") == frozenset({state["signer"]})
+        snap = reg.snapshot()["counters"]
+        assert snap['sign_resigns_total{ceremony="byz"}'] == 1
+        assert snap['sign_quarantined_total{ceremony="byz"}'] == 1
+        # grid 6, one z-tampered cell: 5 passes to blame + 1 clean
+        # re-sign accept-all (each attempt within RlcReport.pass_bound)
+        assert snap['sign_rlc_passes_total{ceremony="byz"}'] == 6
+
+        # quarantine persists: an untampered follow-up signs fine with
+        # the culprit still excluded
+        assert sch.sign("byz", MESSAGES, seed=12) == ctx["expected_sig"]
+        assert sch.quarantined("byz") == frozenset({state["signer"]})
+
+        # an attacker forging on EVERY attempt burns one signer per
+        # round until the eligible set starves — typed, not a crash
+        def forge_always(ps):
+            m = len(ps.indices)
+            proofs = list(ps.proofs)
+            p = proofs[0 * m]
+            proofs[0 * m] = dataclasses.replace(
+                p, response=(p.response + 1) % q
+            )
+            return dataclasses.replace(ps, proofs=proofs)
+
+        with pytest.raises(InsufficientSigners, match="eligible"):
+            sch.sign("greedy", MESSAGES, seed=13, tamper=forge_always)
+        assert len(sch.quarantined("greedy")) == N - T  # 3 blamed, 2 left
+        assert 'sign_starved_total{ceremony="greedy"}' in reg.snapshot()[
+            "counters"
+        ]
     finally:
         sch.close()
 
