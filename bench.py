@@ -354,11 +354,18 @@ def _child_capture(code: str, timeout_s: float, cwd: str | None = None):
 def _rung_child(curve: str, n: int, t: int) -> None:
     """One ladder rung, measured in a child process (flags arrive via
     the environment, set by the parent before spawning)."""
+    from dkg_tpu.utils import runtimeobs
+
     _configure_cache()
+    # force=True: the bench opts into compile/cache/memory telemetry
+    # without the knob (DKG_TPU_RUNTIMEOBS=off still wins)
+    runtimeobs.install(force=True)
     t_deal, t_verify, t_rho, fs_sub, table, seal = run(curve, n, t)
+    runtimeobs.sample_memory()
     print(
         json.dumps(
             {
+                "runtime": runtimeobs.snapshot(),
                 "deal_s": round(t_deal, 6),
                 "verify_s": round(t_verify, 6),
                 "fiat_shamir_s": round(t_rho, 6),
@@ -501,6 +508,18 @@ def run(curve: str, n: int, t: int, rho_bits: int = 128):
         e, s, r, rho,
     )
     assert bool(jnp.all(ok)), "batch verification failed in bench"
+    # XLA cost probes on the hot executables: estimated FLOPs/bytes
+    # land in the runtime block next to the measured seconds above
+    # (best-effort — a failed lowering returns None, never raises)
+    from dkg_tpu.utils import runtimeobs
+
+    runtimeobs.probe_jitted(
+        "deal", ce.deal, cfg, c.coeffs_a, c.coeffs_b, c.g_table, c.h_table
+    )
+    runtimeobs.probe_jitted(
+        "verify_batch", ce.verify_batch,
+        cfg, e, s, r, rho, rho_bits, c.g_table, c.h_table,
+    )
     table = {"seconds": c.table_seconds, "stats": dict(c.table_stats)}
     return t_deal, t_verify, t_rho, fs_sub, table, seal
 
@@ -817,6 +836,11 @@ def main():
                     # in-process warmup touched — perf_regress.py passes
                     # this block through untouched
                     "metrics": metrics.REGISTRY.snapshot(),
+                    # the measured child's JAX runtime introspection
+                    # (utils.runtimeobs): compile/cache totals, memory
+                    # peaks, cost probes — perf_regress.py soft-warns
+                    # when compiles_total rises at identical config
+                    "runtime": res.get("runtime"),
                 }
             )
         )

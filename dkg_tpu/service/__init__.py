@@ -28,6 +28,12 @@ service:
   scheduler's isolation machinery branches on (lint DKG010).
 * :mod:`~dkg_tpu.service.faultsvc` — seeded chaos injection for all of
   the above (scripts/service_storm.py is the harness).
+* :mod:`~dkg_tpu.service.httpobs` — the localhost scrape surface
+  (``/metrics``, ``/healthz``, ``/slo``), off unless a port is
+  configured.
+* :mod:`~dkg_tpu.service.slo` — the rolling SLO evaluator (latency
+  quantiles + error-budget burn) behind ``/slo`` and
+  ``scripts/slo_gate.py``.
 
 Entry points: :class:`~dkg_tpu.service.scheduler.CeremonyScheduler`,
 :class:`~dkg_tpu.service.engine.CeremonyRequest`.  Knobs (all through
@@ -35,7 +41,10 @@ Entry points: :class:`~dkg_tpu.service.scheduler.CeremonyScheduler`,
 ``DKG_TPU_SERVICE_QUEUE_DEPTH``, ``DKG_TPU_SERVICE_BATCH_MAX``,
 ``DKG_TPU_SERVICE_DEADLINE_S``, ``DKG_TPU_SERVICE_WAL_DIR``,
 ``DKG_TPU_SERVICE_RETRIES``, ``DKG_TPU_SERVICE_RETRY_BACKOFF_S``,
-``DKG_TPU_SERVICE_MAX_REPLAYS``.
+``DKG_TPU_SERVICE_MAX_REPLAYS``, ``DKG_TPU_SERVICE_HTTP_PORT``,
+``DKG_TPU_SLO_WINDOW_S`` / ``DKG_TPU_SLO_ERROR_BUDGET`` /
+``DKG_TPU_SLO_CEREMONY_P99_S`` / ``DKG_TPU_SLO_SIGN_P99_S`` (and
+``DKG_TPU_RUNTIMEOBS`` via utils.runtimeobs).
 See docs/service.md for the architecture and the bucketing/backpressure
 semantics, docs/fault_model.md for the service fault model, and
 scripts/fleet_bench.py for the throughput benchmark.

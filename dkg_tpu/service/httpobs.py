@@ -1,0 +1,169 @@
+"""Scrape surface for the serving fleet: /metrics, /healthz, /slo.
+
+One stdlib ``http.server`` thread (no dependencies, no frameworks)
+exposing what the process already knows:
+
+* ``/metrics`` — ``registry.prometheus_text()``, the text exposition a
+  Prometheus/Grafana stack scrapes (includes the runtimeobs ``jax_*``
+  series when the introspection layer is installed);
+* ``/healthz`` — the scheduler's liveness dict (workers alive, queue
+  depth, WAL ok) as JSON; 200 when ``ok`` else 503, so a load balancer
+  can probe it directly;
+* ``/slo`` — the rolling :mod:`~dkg_tpu.service.slo` report as JSON;
+  200 when the window is inside its objectives else 503.
+
+**Off by default.**  The server starts only when a port is configured —
+``DKG_TPU_SERVICE_HTTP_PORT`` via utils.envknobs (0 binds an ephemeral
+port, handy for tests) or the scheduler's ``http_port`` argument.  Binds
+localhost only: this is an operator scrape surface, not a public API —
+anything wider is a deployment's reverse-proxy decision.
+
+Redaction stance: every byte served here comes from the metrics
+registry (names, labels, numbers) or the scheduler's health/SLO dicts
+(statuses, counts, latencies) — never from ceremony payloads.  Key
+material cannot transit this surface; tests/test_runtimeobs.py greps
+the responses for the obslog redaction contract.
+
+The handler thread is spawned here rather than in scheduler.py; lint
+DKG007 sanctions exactly this module and the scheduler as service
+thread-spawn sites.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+
+from ..utils import envknobs
+from ..utils.metrics import REGISTRY
+
+#: Seconds close() waits for the serve thread to drain.
+_JOIN_TIMEOUT_S = 5.0
+
+
+class ObsHttpServer:
+    """Owns the listening socket and the one daemon serve thread.
+
+    ``health_fn`` / ``slo_fn`` are zero-arg callables returning
+    JSON-able dicts (the scheduler passes its bound methods); either may
+    be None, which 404s that route.  A callback that raises is recorded
+    (``service_http_errors_total``) and answered 500 — a broken probe
+    must read as unhealthy, not kill the serve thread.
+    """
+
+    def __init__(
+        self,
+        *,
+        registry=None,
+        health_fn=None,
+        slo_fn=None,
+        log=None,
+        port: int = 0,
+        host: str = "127.0.0.1",
+    ) -> None:
+        self.registry = registry if registry is not None else REGISTRY
+        self.health_fn = health_fn
+        self.slo_fn = slo_fn
+        self.log = log
+        server = self
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            # stdlib default logs every request to stderr (DKG006:
+            # telemetry goes through obslog/metrics, not raw streams)
+            def log_message(self, fmt, *args):  # noqa: A003
+                del fmt, args
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_json(self, code: int, payload: dict) -> None:
+                self._send(
+                    code,
+                    json.dumps(payload, sort_keys=True).encode(),
+                    "application/json",
+                )
+
+            def do_GET(self):  # noqa: N802 (BaseHTTPRequestHandler API)
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        self._send(
+                            200,
+                            server.registry.prometheus_text().encode(),
+                            "text/plain; version=0.0.4",
+                        )
+                    elif path == "/healthz" and server.health_fn is not None:
+                        health = server.health_fn()
+                        self._send_json(
+                            200 if health.get("ok") else 503, health
+                        )
+                    elif path == "/slo" and server.slo_fn is not None:
+                        report = server.slo_fn()
+                        self._send_json(200 if report.get("ok") else 503, report)
+                    else:
+                        self._send_json(404, {"error": "not found", "path": path})
+                except Exception as exc:
+                    server._note(path, exc)
+                    try:
+                        self._send_json(500, {"error": type(exc).__name__})
+                    except Exception as exc2:
+                        # client already gone mid-response; count it too
+                        server._note(path, exc2)
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="dkg-svc-http",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def _note(self, path: str, exc: BaseException) -> None:
+        self.registry.inc("service_http_errors_total", path=path)
+        if self.log is not None:
+            self.log.emit(
+                "http_error", path=path, kind=type(exc).__name__, error=str(exc)
+            )
+
+    @property
+    def port(self) -> int:
+        """The bound port (the ephemeral one when constructed with 0)."""
+        return self._httpd.server_address[1]
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=_JOIN_TIMEOUT_S)
+
+
+def maybe_start(
+    *,
+    registry=None,
+    health_fn=None,
+    slo_fn=None,
+    log=None,
+    port: int | None = None,
+) -> ObsHttpServer | None:
+    """Start a server iff a port is configured: the explicit ``port``
+    argument wins, else ``DKG_TPU_SERVICE_HTTP_PORT``; both unset means
+    the surface stays off and this returns None."""
+    if port is None:
+        port = envknobs.nonneg_int(
+            "DKG_TPU_SERVICE_HTTP_PORT",
+            "observability HTTP port (0 = ephemeral; unset = off)",
+        )
+    if port is None:
+        return None
+    return ObsHttpServer(
+        registry=registry,
+        health_fn=health_fn,
+        slo_fn=slo_fn,
+        log=log,
+        port=port,
+    )

@@ -6,10 +6,11 @@ transcript digests (hashlib releases the GIL), and numpy transfers —
 all of which overlap fine under threads, while an asyncio design would
 have to push every one of those blocking calls to an executor *anyway*
 (JAX has no awaitable dispatch API) and would gain nothing but an event
-loop to babysit.  The pool is the ONE sanctioned thread-spawn site in
-this package (scripts/lint_lite.py DKG007); everything else in
+loop to babysit.  The pool here and the scrape-server thread in
+service/httpobs.py are the only sanctioned thread-spawn sites in this
+package (scripts/lint_lite.py DKG007); everything else in
 ``dkg_tpu/service/`` must stay thread-free so the concurrency story has
-a single owner.
+few owners.
 
 Flow:
 
@@ -53,7 +54,11 @@ journal directory, unset = durability off), ``DKG_TPU_SERVICE_RETRIES``
 (transient-fault convoy retries, default 2, 0 disables),
 ``DKG_TPU_SERVICE_RETRY_BACKOFF_S`` (first backoff, doubling, default
 0.05), ``DKG_TPU_SERVICE_MAX_REPLAYS`` (journal crash-loop guard,
-default 3 — see service.durable).
+default 3 — see service.durable), ``DKG_TPU_SERVICE_HTTP_PORT``
+(observability scrape surface — service/httpobs; unset = off),
+``DKG_TPU_RUNTIMEOBS`` (JAX compile/memory telemetry —
+utils/runtimeobs), ``DKG_TPU_SLO_*`` (rolling SLO objectives —
+service/slo).
 """
 
 from __future__ import annotations
@@ -68,10 +73,11 @@ import numpy as np
 from ..epoch import inprocess as epoch_inprocess
 from ..fields import host as fh
 from ..groups import host as gh
-from ..utils import envknobs, obslog
+from ..utils import envknobs, obslog, runtimeobs
 from ..utils.metrics import REGISTRY
-from . import buckets, errors
+from . import buckets, errors, httpobs
 from .durable import ServiceJournal
+from .slo import SloEvaluator
 from .engine import (
     CeremonyOutcome,
     CeremonyRequest,
@@ -123,6 +129,8 @@ class CeremonyScheduler:
         log=None,
         runtime: WarmRuntime | None = None,
         metrics=REGISTRY,
+        http_port: int | None = None,
+        slo_policy=None,
     ) -> None:
         if concurrency is None:
             concurrency = envknobs.pos_int(
@@ -202,6 +210,18 @@ class CeremonyScheduler:
             target=self._watchdog_loop, name="dkg-svc-watchdog", daemon=True
         )
         self._watchdog.start()
+        # runtime introspection (knob-gated: DKG_TPU_RUNTIMEOBS=on — a
+        # no-op returning False otherwise) and the scrape surface (off
+        # unless http_port / DKG_TPU_SERVICE_HTTP_PORT is configured)
+        runtimeobs.install(registry=metrics, log=self._log)
+        self.slo = SloEvaluator(registry=metrics, policy=slo_policy)
+        self._http = httpobs.maybe_start(
+            registry=metrics,
+            health_fn=self.health,
+            slo_fn=self.slo_report,
+            log=self._log,
+            port=http_port,
+        )
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -242,6 +262,8 @@ class CeremonyScheduler:
         for w in self._workers:
             w.join(timeout=60)
         self._watchdog.join(timeout=60)
+        if self._http is not None:
+            self._http.close()
         if self._own_log and self._log is not None:
             self._log.close()
 
@@ -350,6 +372,33 @@ class CeremonyScheduler:
             self.metrics.set_gauge("service_queue_depth", len(self._queue))
             self._cond.notify()
         return cid
+
+    def health(self) -> dict:
+        """Liveness dict (the ``/healthz`` payload — service/httpobs):
+        ``ok`` means accepting work with a live pool.  Dead workers are
+        watchdog-respawned, so the bar is "any worker alive", not "all";
+        a fully dead pool or a closed/draining scheduler reads not-ok."""
+        with self._cond:
+            alive = sum(1 for w in self._workers if w.is_alive())
+            total = len(self._workers)
+            depth = len(self._queue)
+            running = self._running
+            draining = self._draining
+        return {
+            "ok": bool(running and not draining and alive > 0),
+            "running": running,
+            "draining": draining,
+            "workers_alive": alive,
+            "workers_total": total,
+            "queue_depth": depth,
+            "queue_capacity": self.queue_depth,
+            "wal": "ok" if self._journal is not None else "off",
+        }
+
+    def slo_report(self) -> dict:
+        """Rolling-window SLO judgment (the ``/slo`` payload — see
+        service/slo.py for the window/quantile/error-budget math)."""
+        return self.slo.report()
 
     def poll(self, cid: str) -> str:
         """Current status: queued | running | done | failed | expired |
@@ -843,6 +892,9 @@ class CeremonyScheduler:
         self.metrics.observe(
             "service_convoy_seconds", dt, width=str(len(convoy))
         )
+        # device/host memory watermarks at the convoy boundary (no-op
+        # unless runtimeobs is installed; internally throttled)
+        runtimeobs.maybe_sample(phase="convoy_finish")
 
     # -- blast-radius isolation ---------------------------------------------
 

@@ -28,6 +28,15 @@ convoy retries, 0 disables) / DKG_TPU_SERVICE_RETRY_BACKOFF_S (first
 backoff, doubling) / DKG_TPU_SERVICE_MAX_REPLAYS (journal crash-loop
 guard) scheduler knobs via service.scheduler — lint rule DKG007 bans
 any other environment access in dkg_tpu/service/,
+DKG_TPU_RUNTIMEOBS (on|off — JAX compile/cache/memory introspection)
+via utils.runtimeobs,
+DKG_TPU_SERVICE_HTTP_PORT (observability HTTP port; 0 binds an
+ephemeral port, unset keeps the scrape surface off) via
+service.httpobs,
+DKG_TPU_SLO_WINDOW_S / DKG_TPU_SLO_ERROR_BUDGET /
+DKG_TPU_SLO_CEREMONY_P99_S / DKG_TPU_SLO_SIGN_P99_S (rolling SLO
+window, allowed failure ratio, and latency objectives) via
+service.slo,
 DKG_TPU_SIGN_RLC_DISPATCH (host|device RLC combine leg) via
 sign.verify,
 DKG_TPU_EPOCH_MAX_CHURN (leave+join budget a reshare accepts; 0

@@ -138,7 +138,17 @@ class MetricsRegistry:
         with self._lock:
             counters = sorted(self._counters.items())
             gauges = sorted(self._gauges.items())
-            hists = sorted(self._hists.items())
+            # deep-copy histogram state INSIDE the lock: the dict values
+            # are the live mutable [buckets, counts, sum, count] lists
+            # observe() mutates, so reading them field-by-field after
+            # release can render a bucket row from one observation and
+            # the sum/count from another (the +Inf bucket would disagree
+            # with _count in the same exposition)
+            hists = [
+                ((name, li), (buckets, list(counts), total, count))
+                for (name, li), (buckets, counts, total, count)
+                in sorted(self._hists.items())
+            ]
         lines: list[str] = []
         seen: set[str] = set()
         for (name, li), v in counters:

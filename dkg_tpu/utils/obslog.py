@@ -256,6 +256,11 @@ def load_jsonl(path: str | os.PathLike) -> list[dict]:
     return out
 
 
+#: Dedicated thread id for the per-process "jax compile" track — far
+#: above any real party index so it sorts last in the timeline.
+_JAX_COMPILE_TID = 9999
+
+
 def _tid(ev: dict) -> int:
     party = ev.get("party")
     return party + 1 if isinstance(party, int) else 0
@@ -268,9 +273,13 @@ def to_chrome_trace(events: Iterable[dict]) -> dict:
     Mapping: one *process* per ceremony_id, one *thread* per party (the
     hub is tid 0); ``span`` events become complete ("X") slices with
     their ``subs`` rendered as nested child slices laid out sequentially
-    from the parent's start; every other kind becomes an instant ("i").
-    Wall-clock timestamps align events across OS processes — parties of
-    one chaos restart run land on one coherent timeline.
+    from the parent's start; runtimeobs ``jax_compile`` events become
+    "X" slices on a dedicated per-process "jax compile" thread (so
+    compiles visibly overlap — or starve — ceremony phases);
+    ``counter_sample`` events become Chrome counter ("C") tracks; every
+    other kind becomes an instant ("i").  Wall-clock timestamps align
+    events across OS processes — parties of one chaos restart run land
+    on one coherent timeline.
     """
     events = [ev for ev in events if isinstance(ev, dict)]
     if not events:
@@ -282,6 +291,7 @@ def to_chrome_trace(events: Iterable[dict]) -> dict:
 
     t0 = min(wall0(ev) for ev in events)
     pids: dict[str, int] = {}
+    compile_tids: set[int] = set()
     trace: list[dict] = []
     for ev in events:
         cid = str(ev.get("ceremony_id", "proc"))
@@ -334,6 +344,45 @@ def to_chrome_trace(events: Iterable[dict]) -> dict:
                     }
                 )
                 sub_ts += sub_dur
+        elif ev.get("kind") == "jax_compile":
+            # runtimeobs compile-stage events: their own thread per
+            # process, so recompiles read as a parallel track next to
+            # the ceremony phases they delay
+            if pid not in compile_tids:
+                compile_tids.add(pid)
+                trace.append(
+                    {
+                        "name": "thread_name",
+                        "ph": "M",
+                        "pid": pid,
+                        "tid": _JAX_COMPILE_TID,
+                        "args": {"name": "jax compile"},
+                    }
+                )
+            trace.append(
+                {
+                    "name": f"compile/{ev.get('stage', '?')}",
+                    "ph": "X",
+                    "pid": pid,
+                    "tid": _JAX_COMPILE_TID,
+                    "ts": (wall0(ev) - t0) * 1e6,
+                    "dur": float(ev.get("dur_s", 0.0)) * 1e6,
+                    "args": args,
+                }
+            )
+        elif ev.get("kind") == "counter_sample":
+            # runtimeobs memory watermarks (and any future sampled
+            # gauges): Chrome counter tracks, one per counter name
+            trace.append(
+                {
+                    "name": str(ev.get("counter", "counter")),
+                    "ph": "C",
+                    "pid": pid,
+                    "tid": 0,
+                    "ts": (wall0(ev) - t0) * 1e6,
+                    "args": {"value": ev.get("value", 0)},
+                }
+            )
         else:
             trace.append(
                 {

@@ -1,0 +1,489 @@
+"""JAX runtime introspection: compile, cache, memory, and cost telemetry.
+
+The obslog/metrics layer records what OUR code does; this module makes
+the JAX runtime underneath it observable — the telemetry that separates
+"the ceremony took 30 s" from "the ceremony took 0.8 s and sat behind a
+29 s recompile".  Three legs, all feeding the existing process-wide
+:data:`~dkg_tpu.utils.metrics.REGISTRY` and the ambient flight recorder:
+
+* **compile telemetry** — ``jax.monitoring`` listeners (registered once
+  per process; :func:`install` is idempotent) turn the runtime's
+  compile-stage duration events into the ``jax_compile_seconds{stage=}``
+  histogram and ``jax_compiles_total`` counter, and the persistent
+  compile-cache events into ``jax_compile_cache_total{outcome=hit|miss}``
+  — the counter that distinguishes a warm second process from one
+  silently recompiling everything (ROADMAP item 5's cold-start work is
+  unmeasurable without it).
+* **memory accounting** — :func:`sample_memory` reads per-device
+  ``memory_stats()`` watermarks into gauges (TPU; on CPU backends the
+  runtime returns no stats and the live-``jax.Array`` byte total stands
+  in) and :func:`maybe_sample` throttles that into phase boundaries via
+  ``tracing.phase_span``.
+* **cost probes** — :func:`probe_executable` runs XLA's
+  ``cost_analysis()`` / ``memory_analysis()`` over a lowered or compiled
+  hot executable (deal/verify/sign) so bench lines carry estimated
+  FLOPs/bytes next to measured seconds, keyed by a shape fingerprint.
+
+Everything is OFF until :func:`install` runs.  The ``DKG_TPU_RUNTIMEOBS``
+knob (``on``/``off`` via envknobs) arms implicit installation (the
+scheduler installs when ``on``) and is the operator kill-switch: ``off``
+wins even over ``install(force=True)`` (which is how the benches opt in
+without the knob).  ``jax.monitoring`` has no per-listener unregister,
+so the listeners stay registered for the life of the process and every
+callback gates on the module's ``enabled`` flag — :func:`uninstall` is
+cheap and exact.
+
+Redaction: listener payloads and probe records carry ONLY stage names,
+durations, shapes/dtypes, and byte/FLOP counts — never key material —
+and every obslog emission goes through ``ObsLog.emit``'s sanitizer.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from collections import deque
+
+from . import envknobs, metrics, obslog
+
+#: Compile-duration buckets: DEFAULT_BUCKETS tops out at 60 s, but a
+#: cold stacked-lane or BLS compile runs minutes (ROADMAP: 222 s FLEET
+#: warmup, 83.8 s cold BLS verify) — the tail the histogram exists to
+#: expose must not collapse into one overflow bucket.
+COMPILE_BUCKETS = metrics.DEFAULT_BUCKETS + (120.0, 300.0, 600.0)
+
+#: jax.monitoring point events -> (counter name, labels).
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+_EVENT_COUNTERS = {
+    _CACHE_HIT_EVENT: ("jax_compile_cache_total", {"outcome": "hit"}),
+    _CACHE_MISS_EVENT: ("jax_compile_cache_total", {"outcome": "miss"}),
+}
+
+#: jax.monitoring duration events -> jax_compile_seconds stage label.
+#: ``backend_compile`` is the terminal stage — but JAX wraps the whole
+#: ``compile_or_get_cached`` in it, so it also fires on a persistent
+#: cache HIT.  Each hit emits a cache_hits point event first, so the
+#: pairing in _on_duration claims one hit per terminal event and only
+#: unclaimed terminal events count as executables actually built
+#: (jax_compiles_total).
+_TERMINAL_STAGE = "backend_compile"
+_DURATION_STAGES = {
+    "/jax/core/compile/jaxpr_trace_duration": "trace",
+    "/jax/core/compile/jaxpr_to_mlir_module_duration": "lower",
+    "/jax/core/compile/backend_compile_duration": _TERMINAL_STAGE,
+    "/jax/compilation_cache/cache_retrieval_time_sec": "cache_retrieval",
+}
+
+#: Bounded ring of per-compile event records kept for snapshot()/traces.
+_RING_CAPACITY = 512
+#: snapshot() carries at most this many trailing compile events.
+_SNAPSHOT_EVENTS = 32
+#: maybe_sample() floor between device-memory samples: phase_span runs
+#: in per-round loops and a live_arrays() walk per span is real cost.
+_MIN_SAMPLE_GAP_S = 1.0
+
+
+class _State:
+    """Process-wide listener state.  One instance, module-lifetime; the
+    lock guards the aggregates, never the registry (which has its own)."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.listeners_registered = False  # jax.monitoring hookup done
+        self.enabled = False               # callbacks forwarding
+        self.registry = metrics.REGISTRY
+        self.log: obslog.ObsLog | None = None
+        self.seq = 0
+        self.compiles = 0                  # terminal events minus cache hits
+        self.unclaimed_cache_hits = 0      # hits awaiting their terminal event
+        self.stage_agg: dict[str, list] = {}      # stage -> [count, sum_s]
+        self.event_counts: dict[str, int] = {}    # raw event -> count
+        self.compile_events: deque[dict] = deque(maxlen=_RING_CAPACITY)
+        self.executables: dict[str, dict] = {}    # name -> probe record
+        self.peak_device_bytes: int | None = None
+        self.peak_live_bytes = 0
+        self.last_sample_mono = 0.0
+
+
+_STATE = _State()
+
+
+def _knob() -> str | None:
+    return envknobs.choice(
+        "DKG_TPU_RUNTIMEOBS",
+        ("on", "off"),
+        "JAX runtime introspection listeners (compile/cache/memory telemetry)",
+    )
+
+
+def _emit(kind: str, **fields) -> None:
+    """Into the ambient recorder when one is bound (party/scheduler
+    threads), else the log install() was handed, else drop."""
+    log = obslog.current()
+    if log is None:
+        log = _STATE.log
+    if log is not None:
+        log.emit(kind, **fields)
+
+
+# -- jax.monitoring callbacks (registered once, gated on enabled) ------------
+
+
+def _on_event(event: str, **kw) -> None:
+    st = _STATE
+    if not st.enabled:
+        return
+    mapped = _EVENT_COUNTERS.get(event)
+    if mapped is None:
+        return
+    name, labels = mapped
+    st.registry.inc(name, **labels)
+    with st.lock:
+        st.event_counts[event] = st.event_counts.get(event, 0) + 1
+        if event == _CACHE_HIT_EVENT:
+            st.unclaimed_cache_hits += 1
+
+
+def _on_duration(event: str, duration_s: float, **kw) -> None:
+    st = _STATE
+    if not st.enabled:
+        return
+    stage = _DURATION_STAGES.get(event)
+    if stage is None:
+        return
+    st.registry.observe(
+        "jax_compile_seconds", duration_s, COMPILE_BUCKETS, stage=stage
+    )
+    now = time.time()
+    built = False
+    with st.lock:
+        agg = st.stage_agg.setdefault(stage, [0, 0.0])
+        agg[0] += 1
+        agg[1] += duration_s
+        st.seq += 1
+        rec = {
+            "seq": st.seq,
+            "stage": stage,
+            "dur_s": round(duration_s, 6),
+            "ts": now,
+        }
+        if stage == _TERMINAL_STAGE:
+            # a terminal event preceded by an unclaimed cache_hits point
+            # event is a persistent-cache retrieval, not a build
+            if st.unclaimed_cache_hits > 0:
+                st.unclaimed_cache_hits -= 1
+                rec["cached"] = True
+            else:
+                st.compiles += 1
+                built = True
+        st.compile_events.append(rec)
+    if built:
+        st.registry.inc("jax_compiles_total")
+    # the span starts dur_s ago by construction: the runtime fires the
+    # event at stage completion, so ts0/mono0 back-date it for the trace
+    _emit(
+        "jax_compile",
+        stage=stage,
+        dur_s=duration_s,
+        ts0=now - duration_s,
+        mono0=time.monotonic() - duration_s,
+        seq=rec["seq"],
+    )
+
+
+# -- lifecycle ----------------------------------------------------------------
+
+
+def install(
+    registry: metrics.MetricsRegistry | None = None,
+    log: obslog.ObsLog | None = None,
+    force: bool = False,
+) -> bool:
+    """Arm the runtime listeners; returns True when telemetry is live.
+
+    Idempotent: the ``jax.monitoring`` registration happens at most once
+    per process (there is no per-listener unregister), repeat calls just
+    retarget ``registry``/``log`` and re-enable.  Gating:
+
+    * ``DKG_TPU_RUNTIMEOBS=off`` — hard off, even with ``force`` (the
+      operator kill-switch);
+    * ``DKG_TPU_RUNTIMEOBS=on`` — on;
+    * unset — on only for ``force=True`` callers (benches, tests);
+      implicit installers (the scheduler) stay off by default.
+    """
+    knob = _knob()
+    if knob == "off" or (knob is None and not force):
+        return False
+    st = _STATE
+    with st.lock:
+        if registry is not None:
+            st.registry = registry
+        if log is not None:
+            st.log = log
+        if not st.listeners_registered:
+            import jax.monitoring
+
+            jax.monitoring.register_event_listener(_on_event)
+            jax.monitoring.register_event_duration_secs_listener(_on_duration)
+            st.listeners_registered = True
+        st.enabled = True
+    return True
+
+
+def uninstall() -> None:
+    """Disable the callbacks and drop caller-provided targets.  The
+    listeners stay registered (no jax.monitoring unregister) but cost
+    one flag check per event while disabled."""
+    st = _STATE
+    with st.lock:
+        st.enabled = False
+        st.registry = metrics.REGISTRY
+        st.log = None
+
+
+def enabled() -> bool:
+    return _STATE.enabled
+
+
+def _reset_for_tests() -> None:
+    """Uninstall and clear every aggregate (tests only — production
+    telemetry is cumulative by design)."""
+    st = _STATE
+    uninstall()
+    with st.lock:
+        st.seq = 0
+        st.compiles = 0
+        st.unclaimed_cache_hits = 0
+        st.stage_agg.clear()
+        st.event_counts.clear()
+        st.compile_events.clear()
+        st.executables.clear()
+        st.peak_device_bytes = None
+        st.peak_live_bytes = 0
+        st.last_sample_mono = 0.0
+
+
+# -- memory accounting --------------------------------------------------------
+
+
+def sample_memory(
+    registry: metrics.MetricsRegistry | None = None,
+    phase: str | None = None,
+) -> dict:
+    """One device-memory sample into the watermark gauges.
+
+    TPU/GPU runtimes report allocator stats per device
+    (``bytes_in_use`` / ``peak_bytes_in_use`` -> the
+    ``jax_device_bytes_in_use`` / ``jax_device_peak_bytes`` gauges); the
+    CPU backend returns None, so the live-``jax.Array`` byte total
+    (``jax_live_buffer_bytes``/``_count``) is always sampled as the
+    backend-independent floor.  Returns the sample dict; also emits
+    ``counter_sample`` events the Chrome-trace export renders as counter
+    tracks.
+    """
+    import jax
+
+    st = _STATE
+    reg = registry if registry is not None else st.registry
+    per_dev: dict[str, dict] = {}
+    in_use_total = 0
+    peak = 0
+    have_stats = False
+    for d in jax.local_devices():
+        try:
+            ms = d.memory_stats()
+        except Exception:  # noqa: BLE001 — stats are best-effort per backend
+            ms = None
+        if not ms:
+            continue
+        have_stats = True
+        biu = int(ms.get("bytes_in_use", 0))
+        pk = int(ms.get("peak_bytes_in_use", biu))
+        per_dev[str(d.id)] = {"bytes_in_use": biu, "peak_bytes_in_use": pk}
+        reg.set_gauge("jax_device_bytes_in_use", biu, device=str(d.id))
+        reg.set_gauge("jax_device_peak_bytes", pk, device=str(d.id))
+        in_use_total += biu
+        peak = max(peak, pk)
+    live = jax.live_arrays()
+    live_bytes = int(sum(int(getattr(x, "nbytes", 0) or 0) for x in live))
+    reg.set_gauge("jax_live_buffer_bytes", live_bytes)
+    reg.set_gauge("jax_live_buffer_count", len(live))
+    out = {
+        "devices": per_dev,
+        "peak_device_bytes": peak if have_stats else None,
+        "live_buffer_bytes": live_bytes,
+        "live_buffer_count": len(live),
+    }
+    with st.lock:
+        if have_stats:
+            st.peak_device_bytes = max(st.peak_device_bytes or 0, peak)
+        st.peak_live_bytes = max(st.peak_live_bytes, live_bytes)
+    _emit(
+        "counter_sample",
+        counter="jax_live_buffer_bytes",
+        value=live_bytes,
+        phase=phase,
+    )
+    if have_stats:
+        _emit(
+            "counter_sample",
+            counter="jax_device_bytes_in_use",
+            value=in_use_total,
+            phase=phase,
+        )
+    return out
+
+
+def maybe_sample(phase: str | None = None) -> None:
+    """Throttled :func:`sample_memory` for hot callers (phase
+    boundaries, convoy completions): no-op unless installed, at most one
+    sample per :data:`_MIN_SAMPLE_GAP_S`."""
+    st = _STATE
+    if not st.enabled:
+        return
+    now = time.monotonic()
+    with st.lock:
+        if now - st.last_sample_mono < _MIN_SAMPLE_GAP_S:
+            return
+        st.last_sample_mono = now
+    try:
+        sample_memory(phase=phase)
+    except Exception:  # noqa: BLE001 — a telemetry sample must never
+        pass  # fail the ceremony phase it rides on
+
+
+# -- cost probes --------------------------------------------------------------
+
+
+def _shape_strs(obj) -> list[str]:
+    """``"float32[8,64]"``-style strings for an executable's input avals
+    (shapes and dtypes only — never values)."""
+    avals = getattr(obj, "in_avals", None)
+    if avals is None:
+        return []
+    flat: list = []
+    args, kwargs = (avals if isinstance(avals, tuple) and len(avals) == 2
+                    else (avals, {}))
+    flat.extend(args if isinstance(args, (list, tuple)) else [args])
+    if isinstance(kwargs, dict):
+        flat.extend(kwargs.values())
+    out = []
+    for a in flat:
+        dt = getattr(a, "dtype", None)
+        shape = getattr(a, "shape", None)
+        if shape is None:
+            out.append(str(a))
+        else:
+            dims = ",".join(str(d) for d in shape)
+            out.append(f"{getattr(dt, 'name', dt)}[{dims}]")
+    return out
+
+
+def probe_executable(name: str, obj, registry=None) -> dict:
+    """XLA cost/memory analysis of a ``jax.stages`` Lowered or Compiled
+    object, recorded into the executable registry and the
+    ``jax_executable_*`` gauges.
+
+    ``Lowered.cost_analysis()`` needs no backend compile, so probing a
+    hot function is ~trace cost: ``probe_executable("verify",
+    ce.verify_batch.lower(cfg, e, s, r, rho, bits, gt, ht))``.  A
+    Compiled object additionally yields ``memory_analysis()`` byte
+    footprints.  Works with telemetry disabled (the benches probe
+    unconditionally); the record lands in :func:`snapshot` either way.
+    """
+    import jax
+
+    st = _STATE
+    reg = registry if registry is not None else st.registry
+    info: dict = {"name": str(name)}
+    shapes = _shape_strs(obj)
+    if shapes:
+        info["in_shapes"] = shapes
+    try:
+        info["backend"] = jax.default_backend()
+    except Exception:  # noqa: BLE001 — uninitialised backend is legal here
+        pass
+    h = hashlib.blake2b(digest_size=6)
+    h.update(str(name).encode())
+    for s in shapes:
+        h.update(b"|" + s.encode())
+    info["fingerprint"] = h.hexdigest()
+    try:
+        ca = obj.cost_analysis()
+    except Exception:  # noqa: BLE001 — not every executable has costs
+        ca = None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if isinstance(ca, dict):
+        flops = ca.get("flops")
+        if isinstance(flops, (int, float)) and flops >= 0:
+            info["flops"] = float(flops)
+            reg.set_gauge("jax_executable_flops", float(flops), executable=str(name))
+        nbytes = ca.get("bytes accessed")
+        if isinstance(nbytes, (int, float)) and nbytes >= 0:
+            info["bytes_accessed"] = float(nbytes)
+            reg.set_gauge(
+                "jax_executable_bytes_accessed", float(nbytes), executable=str(name)
+            )
+    mem_fn = getattr(obj, "memory_analysis", None)
+    if callable(mem_fn):
+        try:
+            mem = mem_fn()
+        except Exception:  # noqa: BLE001 — AOT surface varies per backend
+            mem = None
+        for src, dst in (
+            ("argument_size_in_bytes", "argument_bytes"),
+            ("output_size_in_bytes", "output_bytes"),
+            ("temp_size_in_bytes", "temp_bytes"),
+            ("generated_code_size_in_bytes", "code_bytes"),
+        ):
+            v = getattr(mem, src, None)
+            if isinstance(v, int):
+                info[dst] = v
+    with st.lock:
+        st.executables[str(name)] = info
+    _emit("jax_cost_probe", **info)
+    return info
+
+
+def probe_jitted(name: str, fn, *args, registry=None, **kwargs) -> dict | None:
+    """Lower a jitted ``fn`` at the given arguments and probe it; None
+    when lowering fails (a probe must never fail the bench it rides
+    in)."""
+    try:
+        lowered = fn.lower(*args, **kwargs)
+    except Exception:  # noqa: BLE001 — best-effort decoration
+        return None
+    return probe_executable(name, lowered, registry=registry)
+
+
+# -- snapshot -----------------------------------------------------------------
+
+
+def snapshot() -> dict:
+    """The ``runtime`` block bench/fleet/sign rounds embed: compile and
+    cache totals, per-stage aggregates, memory peaks, the executable
+    registry, and the trailing compile events.  Registry-independent
+    (reads this module's own aggregates), so it composes with
+    ``REGISTRY.reset()`` between bench legs."""
+    st = _STATE
+    with st.lock:
+        term = st.stage_agg.get(_TERMINAL_STAGE, (0, 0.0))
+        out = {
+            "enabled": st.enabled,
+            "compiles_total": int(st.compiles),
+            "compile_seconds_sum": round(float(term[1]), 6),
+            "cache_hits": st.event_counts.get(_CACHE_HIT_EVENT, 0),
+            "cache_misses": st.event_counts.get(_CACHE_MISS_EVENT, 0),
+            "stages": {
+                k: {"count": int(v[0]), "sum_s": round(float(v[1]), 6)}
+                for k, v in sorted(st.stage_agg.items())
+            },
+            "peak_device_bytes": st.peak_device_bytes,
+            "peak_live_buffer_bytes": st.peak_live_bytes,
+            "executables": {k: dict(v) for k, v in st.executables.items()},
+            "events": [dict(e) for e in st.compile_events][-_SNAPSHOT_EVENTS:],
+        }
+    return out

@@ -23,7 +23,7 @@ import json
 import time
 from dataclasses import dataclass, field
 
-from . import metrics, obslog
+from . import metrics, obslog, runtimeobs
 
 
 @dataclass
@@ -117,6 +117,9 @@ def phase_span(trace: CeremonyTrace | None, phase: str, annotate_device: bool = 
     if trace is not None:
         trace.record(phase, dt)
     metrics.REGISTRY.observe("dkg_phase_seconds", dt, phase=phase)
+    # device/host memory watermark at the phase boundary (no-op unless
+    # runtimeobs is installed; internally throttled)
+    runtimeobs.maybe_sample(phase=phase)
     if recorder is not None:
         subs = None
         if trace is not None:

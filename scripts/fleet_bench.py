@@ -64,6 +64,7 @@ if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
 
 from dkg_tpu.service import buckets, engine  # noqa: E402
 from dkg_tpu.service.scheduler import CeremonyScheduler  # noqa: E402
+from dkg_tpu.utils import runtimeobs  # noqa: E402
 from dkg_tpu.utils.metrics import REGISTRY  # noqa: E402
 
 # (n, t, count-per-1000): thresholds picked so the whole mix lands on
@@ -242,6 +243,12 @@ def main(argv=None) -> int:
         f"platform {jax.default_backend()}",
         flush=True,
     )
+    # force=True: the bench opts into compile/cache telemetry without
+    # the knob; armed BEFORE warmup so the report's runtime block counts
+    # the expensive (bucket, width) compiles the warm legs then skip.
+    # snapshot() reads runtimeobs' own aggregates, so the REGISTRY.reset
+    # between legs below does not zero it.
+    runtimeobs.install(force=True)
     warm_s = warmup(runtime, reqs, widths)
     print(f"fleet_bench: warmup {warm_s:.1f}s", flush=True)
 
@@ -286,6 +293,10 @@ def main(argv=None) -> int:
         )
         print(f"fleet_bench: speedup {report['speedup']}x", flush=True)
 
+    # taken last so the block covers warmup AND both measured legs (a
+    # warm rerun shows compiles_total collapsing toward zero here)
+    runtimeobs.sample_memory()
+    report["runtime"] = runtimeobs.snapshot()
     pathlib.Path(args.out).write_text(json.dumps(report, indent=1) + "\n")
     print(f"fleet_bench: wrote {args.out}", flush=True)
     ok = report["verify"]["masters_match"] and service["statuses"].get(

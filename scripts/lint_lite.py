@@ -58,9 +58,9 @@ linter), so the committed baseline stays clean between CI runs:
         ``utils.envknobs`` so a typo'd value fails loudly with the
         knob's name and meaning — or a bare thread/process spawn
         (``threading.Thread``, ``ThreadPoolExecutor``, ``Process``, …)
-        outside ``scheduler.py``: the scheduler's worker pool is the ONE
-        place service code may create execution contexts, so
-        concurrency has a single auditable owner (docs/service.md)
+        outside the sanctioned owners (``scheduler.py``'s worker pool,
+        ``httpobs.py``'s scrape-server thread), so concurrency has few
+        auditable owners (docs/service.md)
 * DKG008  (dkg_tpu/epoch/ only) per-pair EC scalar work or ad-hoc
         persistence in epoch code: a ``scalar_mul``/
         ``scalar_mul_vartime`` call lexically inside a loop — epoch
@@ -94,6 +94,13 @@ linter), so the committed baseline stays clean between CI runs:
         TransientEngineError, …) so callers and the isolation logic can
         branch on type, never on message text (docs/fault_model.md
         "Service fault model")
+* DKG011  (dkg_tpu/ only) undocumented metric name: every literal
+        metric name emitted via ``.inc(...)`` / ``.observe(...)`` /
+        ``.set_gauge(...)`` in library code must appear in
+        ``docs/observability.md``'s metric reference, so the scrape
+        surface (``/metrics``, bench snapshots) cannot silently drift
+        from its documentation (allowlist:
+        ``_DKG011_UNDOCUMENTED_OK``)
 
 Exit 0 = clean.  Run: ``python scripts/lint_lite.py`` (from repo root).
 Also executed by tests/test_import_hygiene.py so the default test tier
@@ -171,8 +178,8 @@ _DIGEST_HOST_LEGS = {"_dealer_row_digests"}
 _DKG006_WRITER_ALLOWLIST = {"obslog.py", "precompute.py"}
 
 # Execution-context constructors banned in dkg_tpu/service/ outside the
-# scheduler (DKG007): the worker pool in scheduler.py is the single
-# sanctioned owner of service concurrency.
+# sanctioned owners (DKG007): the worker pool in scheduler.py and the
+# scrape-server thread in httpobs.py.
 _SERVICE_SPAWNERS = {
     "Thread",
     "ThreadPoolExecutor",
@@ -181,7 +188,7 @@ _SERVICE_SPAWNERS = {
     "start_new_thread",
     "run_in_executor",
 }
-_SERVICE_SPAWN_OWNER = "scheduler.py"
+_SERVICE_SPAWN_OWNERS = {"scheduler.py", "httpobs.py"}
 
 # Per-pair EC scalar multiplication entry points banned inside loops in
 # dkg_tpu/epoch/ (DKG008): a host scalar_mul per (dealer, recipient)
@@ -211,6 +218,15 @@ _DKG010_RECORDERS = {
     "_finish_one",
 }
 
+# Registry write methods whose literal first argument is a metric name
+# (DKG011): every such name in dkg_tpu/ must appear in
+# docs/observability.md's metric reference.
+_DKG011_EMITTERS = {"inc", "observe", "set_gauge"}
+
+# Metric names exempt from the DKG011 docs requirement (test-only or
+# deliberately undocumented names; currently none).
+_DKG011_UNDOCUMENTED_OK: set[str] = set()
+
 # The same entry points banned inside loops in dkg_tpu/sign/ (DKG009):
 # a host scalar_mul per (message, signer) pair is the B·(t+1) pathology
 # the broadcast ladder and the batched MSM exist to avoid.  Functions
@@ -223,6 +239,7 @@ class _Checker(ast.NodeVisitor):
     def __init__(self, path: pathlib.Path, tree: ast.Module, source: str):
         self.path = path
         self.problems: list[tuple[int, str, str]] = []
+        self.metric_names: list[tuple[int, str]] = []  # DKG011 emissions
         self.used_names: set[str] = set()
         self.imports: list[tuple[int, str, str, bool]] = []  # line, local, code, reexport
         self.dunder_all: set[str] = set()
@@ -581,6 +598,19 @@ class _Checker(ast.NodeVisitor):
                         "goes through utils.obslog (sanctioned writers: "
                         "utils/obslog.py, groups/precompute.py)",
                     )
+            # DKG011 collection: literal metric names emitted through a
+            # registry write method; run() checks them against the
+            # docs/observability.md reference after the file walk
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in _DKG011_EMITTERS
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                self.metric_names.append(
+                    (node.lineno, node.args[0].value)
+                )
         # DKG007b: config/concurrency ownership in service code —
         # os.getenv bypasses envknobs' validation, and any execution
         # context created outside scheduler.py's worker pool splits the
@@ -600,14 +630,15 @@ class _Checker(ast.NodeVisitor):
                 )
             if (
                 name in _SERVICE_SPAWNERS
-                and self.path.name != _SERVICE_SPAWN_OWNER
+                and self.path.name not in _SERVICE_SPAWN_OWNERS
             ):
                 self._add(
                     node,
                     "DKG007",
                     f"{name}() in dkg_tpu/service/ — the scheduler's "
-                    "worker pool (service/scheduler.py) is the only "
-                    "sanctioned thread/process spawn site",
+                    "worker pool (service/scheduler.py) and the scrape "
+                    "server (service/httpobs.py) are the only sanctioned "
+                    "thread/process spawn sites",
                 )
         # DKG008: epoch code must scale like the ceremony — EC scalar
         # mults go through the batched entry points (epoch/dealing.py),
@@ -710,6 +741,7 @@ class _Checker(ast.NodeVisitor):
 
 def run() -> int:
     bad = 0
+    emitted: list[tuple[pathlib.Path, int, str]] = []
     for path in _iter_files():
         source = path.read_text()
         try:
@@ -718,9 +750,42 @@ def run() -> int:
             print(f"{path}:{exc.lineno}: E999 {exc.msg}")
             bad += 1
             continue
-        for line, code, msg in _Checker(path, tree, source).finish():
+        checker = _Checker(path, tree, source)
+        for line, code, msg in checker.finish():
             print(f"{path.relative_to(REPO)}:{line}: {code} {msg}")
             bad += 1
+        if "dkg_tpu/" in path.as_posix():
+            emitted.extend(
+                (path, line, name) for line, name in checker.metric_names
+            )
+    bad += _check_metric_docs(emitted)
+    return bad
+
+
+def _check_metric_docs(emitted: list[tuple[pathlib.Path, int, str]]) -> int:
+    """DKG011: every metric name library code emits must appear in the
+    docs/observability.md metric reference (substring match — the docs
+    render names in backticked table rows)."""
+    docs = REPO / "docs" / "observability.md"
+    try:
+        reference = docs.read_text()
+    except OSError:
+        print(f"{docs.relative_to(REPO)}:1: DKG011 metric reference missing")
+        return 1
+    bad = 0
+    seen: set[str] = set()
+    for path, line, name in emitted:
+        if name in _DKG011_UNDOCUMENTED_OK or name in reference:
+            continue
+        if name in seen:  # one report per name, not per emission site
+            continue
+        seen.add(name)
+        print(
+            f"{path.relative_to(REPO)}:{line}: DKG011 metric "
+            f"{name!r} not documented in docs/observability.md's metric "
+            "reference"
+        )
+        bad += 1
     return bad
 
 

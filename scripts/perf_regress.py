@@ -71,6 +71,7 @@ Run: ``python scripts/perf_regress.py [--threshold 0.2] [dir]``.
 from __future__ import annotations
 
 import argparse
+import importlib.util
 import json
 import pathlib
 import re
@@ -127,6 +128,7 @@ def main(argv: list[str] | None = None) -> int:
         or epoch_gate(root, args.threshold)
         or sign_gate(root, args.threshold)
         or svcstorm_gate(root)
+        or _slo_gate(root)
     )
 
     rounds = _load_rounds(root)
@@ -210,7 +212,65 @@ def main(argv: list[str] | None = None) -> int:
             f"perf_regress: r{new_n} carries a metrics snapshot "
             f"({n_series} series) — passed through, not gated"
         )
+    _runtime_drift(old, new, old_n, new_n)
     return bad or fleet_bad
+
+
+def _runtime_drift(old: dict, new: dict, old_n: int, new_n: int) -> None:
+    """Soft warning (never a gate) when compiles_total rose between two
+    rounds at IDENTICAL config flags: a warm rerun of the same program
+    set should compile strictly less, so a rise means the persistent
+    compile cache regressed or a shape started churning (ROADMAP item 5
+    evidence).  Rounds without a ``runtime`` block — everything before
+    the introspection layer — are tolerated silently."""
+    old_rt, new_rt = old.get("runtime"), new.get("runtime")
+    if not isinstance(new_rt, dict):
+        return
+    n_comp = new_rt.get("compiles_total")
+    print(
+        f"perf_regress: r{new_n} carries a runtime block "
+        f"({n_comp} compiles, cache {new_rt.get('cache_hits')}h/"
+        f"{new_rt.get('cache_misses')}m) — passed through, not gated"
+    )
+    if not isinstance(old_rt, dict):
+        return
+    if (old.get("config") or {}).get("flags") != (new.get("config") or {}).get(
+        "flags"
+    ):
+        return  # different knobs legitimately compile different programs
+    o_comp = old_rt.get("compiles_total")
+    if (
+        isinstance(o_comp, (int, float))
+        and isinstance(n_comp, (int, float))
+        and n_comp > o_comp
+    ):
+        print(
+            f"perf_regress: WARNING compiles_total rose r{old_n} "
+            f"{int(o_comp)} -> r{new_n} {int(n_comp)} at identical flags "
+            "— compile-cache regression or shape churn (soft warning, "
+            "not gated)"
+        )
+
+
+def _slo_gate(root: pathlib.Path) -> int:
+    """Serving-SLO judgment of the newest FLEET/SVCSTORM/SIGN rounds
+    (scripts/slo_gate.py).  Loaded by path so this script keeps working
+    from any cwd (tests import it the same way); a missing or broken
+    slo_gate module skips with a note rather than failing history-less
+    checkouts."""
+    gate_path = pathlib.Path(__file__).resolve().parent / "slo_gate.py"
+    try:
+        spec = importlib.util.spec_from_file_location("slo_gate", gate_path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        bad = mod.run_gate(root)
+    except Exception as exc:  # noqa: BLE001 — the gate must not brick history-less runs
+        print(f"perf_regress: slo_gate unavailable ({exc}) — skipping")
+        return 0
+    if bad:
+        print(f"perf_regress: slo_gate reports {bad} violation(s)", file=sys.stderr)
+        return 1
+    return 0
 
 
 def _load_fleet_rounds(root: pathlib.Path) -> list[tuple[int, dict]]:

@@ -52,6 +52,7 @@ if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
 
 from dkg_tpu import sign as signing  # noqa: E402
 from dkg_tpu.groups import host as gh  # noqa: E402
+from dkg_tpu.utils import runtimeobs  # noqa: E402
 from dkg_tpu.utils.metrics import REGISTRY  # noqa: E402
 
 
@@ -143,6 +144,9 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default="SIGN_r01.json")
     args = ap.parse_args(argv)
 
+    # force=True: the bench opts into compile/cache telemetry without
+    # the knob (DKG_TPU_RUNTIMEOBS=off still wins)
+    runtimeobs.install(force=True)
     shapes = []
     ok = True
     for curve in args.curves.split(","):
@@ -172,6 +176,7 @@ def main(argv=None) -> int:
         "seed": args.seed,
         "shapes": shapes,
         "metrics": REGISTRY.snapshot(),
+        "runtime": runtimeobs.snapshot(),
     }
     pathlib.Path(args.out).write_text(json.dumps(report, indent=1) + "\n")
     print(f"sign_bench: wrote {args.out}", flush=True)

@@ -1,0 +1,191 @@
+"""SLO gate: judge the newest bench rounds against the serving SLOs.
+
+The offline leg of ``dkg_tpu/service/slo.py``: where the live
+``/slo`` endpoint judges a rolling window of a running scheduler, this
+script judges the **newest artifact of each serving benchmark** —
+
+* ``FLEET_r{NN}.json`` — ``slo.evaluate`` over the embedded metrics
+  snapshot: ceremony latency quantiles from the
+  ``service_ceremony_seconds`` histograms and error-budget burn over
+  ``service_completed_total{status=...}`` (every terminal status that
+  is not ``done`` spends budget);
+* ``SVCSTORM_r{NN}.json`` — the storm deliberately poisons requests,
+  so naive error budgets would always fail it; the SLO here is the
+  convoy block's ``survival_rate`` (healthy requests completing
+  bit-identically despite the storm) staying >= 1 - error_budget;
+* ``SIGN_r{NN}.json`` — ``sign_seconds`` quantiles when the round
+  carries them (older rounds embed an empty metrics block: noted and
+  skipped, never failed).
+
+Forgiving by design, exactly like perf_regress: a missing round, an
+empty metrics block, or a series that does not exist yet reads as
+"nothing to judge" (exit 0 with a note), so the gate can land before
+the first instrumented round exists.  ``scripts/perf_regress.py`` runs
+:func:`run_gate` as part of its fleet gating.
+
+Usage::
+
+    python scripts/slo_gate.py [root] [--error-budget 0.01]
+        [--ceremony-p99-s N] [--sign-p99-s N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import re
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from dkg_tpu.service import slo  # noqa: E402
+
+_ROUND_PATS = {
+    "fleet": re.compile(r"FLEET_r(\d+)\.json$"),
+    "svcstorm": re.compile(r"SVCSTORM_r(\d+)\.json$"),
+    "sign": re.compile(r"SIGN_r(\d+)\.json$"),
+}
+
+
+def _newest_round(root: pathlib.Path, kind: str) -> tuple[str, dict] | None:
+    """(filename, parsed JSON) of the highest-numbered round, or None.
+    Unparseable files are skipped — the gate judges rounds, it does not
+    police their serialization."""
+    pat = _ROUND_PATS[kind]
+    best: tuple[int, str, dict] | None = None
+    for path in sorted(root.glob(f"{kind.upper()}_r*.json")):
+        m = pat.search(path.name)
+        if not m:
+            continue
+        try:
+            data = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError):
+            continue
+        num = int(m.group(1))
+        if best is None or num > best[0]:
+            best = (num, path.name, data)
+    if best is None:
+        return None
+    return best[1], best[2]
+
+
+def _judge_fleet(root: pathlib.Path, policy: slo.SloPolicy) -> tuple[int, str]:
+    newest = _newest_round(root, "fleet")
+    if newest is None:
+        return 0, "slo_gate: no FLEET rounds — nothing to judge"
+    name, data = newest
+    snap = data.get("metrics") or {}
+    if not (snap.get("histograms") or snap.get("counters")):
+        return 0, f"slo_gate: {name} carries no metrics snapshot — skipped"
+    rep = slo.evaluate(snap, policy)
+    if rep["ceremony"] is None and not rep["errors"]["completed"]:
+        return 0, f"slo_gate: {name} has no service series — skipped"
+    if rep["ok"]:
+        cer = rep["ceremony"] or {}
+        return 0, (
+            f"slo_gate: {name} OK — ceremony p50 {cer.get('p50_s')}s "
+            f"p99 {cer.get('p99_s')}s, error burn {rep['errors']['burn']}"
+        )
+    return 1, f"slo_gate: {name} VIOLATED — {'; '.join(rep['violations'])}"
+
+
+def _judge_svcstorm(
+    root: pathlib.Path, policy: slo.SloPolicy
+) -> tuple[int, str]:
+    newest = _newest_round(root, "svcstorm")
+    if newest is None:
+        return 0, "slo_gate: no SVCSTORM rounds — nothing to judge"
+    name, data = newest
+    convoy = data.get("convoy") or {}
+    rate = convoy.get("survival_rate")
+    if not isinstance(rate, (int, float)):
+        return 0, f"slo_gate: {name} has no convoy survival_rate — skipped"
+    floor = 1.0 - policy.error_budget
+    if rate >= floor:
+        return 0, f"slo_gate: {name} OK — survival_rate {rate} >= {floor}"
+    return 1, (
+        f"slo_gate: {name} VIOLATED — survival_rate {rate} < {floor} "
+        "(healthy requests lost to the storm beyond the error budget)"
+    )
+
+
+def _judge_sign(root: pathlib.Path, policy: slo.SloPolicy) -> tuple[int, str]:
+    newest = _newest_round(root, "sign")
+    if newest is None:
+        return 0, "slo_gate: no SIGN rounds — nothing to judge"
+    name, data = newest
+    merged = slo.merge_histograms(data.get("metrics") or {}, "sign_seconds")
+    if merged is None or merged["count"] <= 0:
+        return 0, (
+            f"slo_gate: {name} carries no sign_seconds histogram "
+            "(pre-instrumentation round) — skipped"
+        )
+    rep = slo.evaluate(data["metrics"], policy)
+    leg = rep["sign"]
+    if leg is None or leg["ok"]:
+        p99 = leg and leg.get("p99_s")
+        return 0, f"slo_gate: {name} OK — sign p99 {p99}s"
+    return 1, (
+        f"slo_gate: {name} VIOLATED — sign p99 {leg['p99_s']}s > "
+        f"target {leg['target_p99_s']}s"
+    )
+
+
+def run_gate(
+    root: pathlib.Path,
+    error_budget: float | None = None,
+    ceremony_p99_s: float | None = None,
+    sign_p99_s: float | None = None,
+) -> int:
+    """Judge the newest FLEET/SVCSTORM/SIGN rounds under ``root``;
+    prints one line per judgment, returns the count of violations."""
+    policy = slo.SloPolicy(
+        ceremony_p99_s=ceremony_p99_s,
+        sign_p99_s=sign_p99_s,
+        error_budget=(
+            slo.DEFAULT_ERROR_BUDGET if error_budget is None else error_budget
+        ),
+    )
+    bad = 0
+    for judge in (_judge_fleet, _judge_svcstorm, _judge_sign):
+        rc, msg = judge(root, policy)
+        print(msg)
+        bad += rc
+    return bad
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "root", nargs="?", default=".",
+        help="directory holding the *_rNN.json rounds (default: cwd)",
+    )
+    ap.add_argument(
+        "--error-budget", type=float, default=None,
+        help=f"allowed failure ratio (default {slo.DEFAULT_ERROR_BUDGET})",
+    )
+    ap.add_argument(
+        "--ceremony-p99-s", type=float, default=None,
+        help="ceremony p99 latency objective in seconds (default: report only)",
+    )
+    ap.add_argument(
+        "--sign-p99-s", type=float, default=None,
+        help="sign p99 latency objective in seconds (default: report only)",
+    )
+    args = ap.parse_args(argv)
+    bad = run_gate(
+        pathlib.Path(args.root),
+        error_budget=args.error_budget,
+        ceremony_p99_s=args.ceremony_p99_s,
+        sign_p99_s=args.sign_p99_s,
+    )
+    if bad:
+        print(f"slo_gate: {bad} SLO violation(s)")
+        return 1
+    print("slo_gate: all serving SLOs met")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

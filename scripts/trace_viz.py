@@ -4,9 +4,12 @@ Merges any number of per-party event logs (written by ``run_party``
 and the TcpHub when ``DKG_TPU_OBSLOG`` names a directory) into a single
 Chrome trace-event JSON: one process per ceremony, one thread per
 party, ``phase_span`` phases as slices with their ``subtimings_s``
-nested underneath, and point events (publishes, quarantines, retries,
-injected faults) as instants.  Load the output via ``chrome://tracing``
-or https://ui.perfetto.dev.
+nested underneath, point events (publishes, quarantines, retries,
+injected faults) as instants, runtimeobs ``jax_compile`` events as
+slices on a per-process "jax compile" thread (compiles visibly overlap
+or starve ceremony phases), and ``counter_sample`` memory watermarks as
+counter tracks.  Load the output via ``chrome://tracing`` or
+https://ui.perfetto.dev.
 
 Usage::
 
@@ -80,10 +83,13 @@ def main(argv: list[str] | None = None) -> int:
     ceremonies = {str(ev.get("ceremony_id", "proc")) for ev in events}
     parties = {(str(ev.get("ceremony_id")), ev.get("party")) for ev in events}
     spans = sum(1 for ev in events if ev.get("kind") == "span")
+    compiles = sum(1 for ev in events if ev.get("kind") == "jax_compile")
+    counters = sum(1 for ev in events if ev.get("kind") == "counter_sample")
     print(
         f"trace_viz: {len(events)} events from {len(paths)} log(s) -> "
         f"{len(trace['traceEvents'])} trace events ({len(ceremonies)} "
-        f"ceremonies, {len(parties)} party timelines, {spans} spans) "
+        f"ceremonies, {len(parties)} party timelines, {spans} spans, "
+        f"{compiles} jax compiles, {counters} counter samples) "
         f"-> {args.out}"
     )
     return 0

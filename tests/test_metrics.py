@@ -223,3 +223,53 @@ def test_registry_is_thread_safe():
     snap = reg.snapshot()
     assert snap["counters"]["n_total"] == 4000
     assert snap["histograms"]["v_seconds"]["count"] == 4000
+
+
+def test_exposition_consistent_under_concurrent_observe():
+    """Every exposition rendered mid-hammer must be internally
+    consistent: a histogram's +Inf bucket, its _count sample, and the
+    snapshot's count must all describe the same set of observations.
+    The regression this guards: prometheus_text() reading the live
+    mutable bucket lists after releasing the lock, so one row rendered
+    pre-observe and the totals post-observe."""
+    import re
+
+    reg = MetricsRegistry()
+    stop = threading.Event()
+
+    def pound(lane: str):
+        i = 0
+        while not stop.is_set():
+            reg.observe("h_seconds", (i % 7) * 0.01, lane=lane)
+            reg.inc("h_total", lane=lane)
+            i += 1
+
+    threads = [
+        threading.Thread(target=pound, args=(str(k),)) for k in range(4)
+    ]
+    for t in threads:
+        t.start()
+    bucket_re = re.compile(
+        r'^h_seconds_bucket\{lane="(\d)",le="\+Inf"\} (\d+)$'
+    )
+    count_re = re.compile(r'^h_seconds_count\{lane="(\d)"\} (\d+)$')
+    try:
+        for _ in range(300):
+            # JSON snapshot: cumulative +Inf bucket == count, always
+            for series, h in reg.snapshot()["histograms"].items():
+                assert h["buckets"]["+Inf"] == h["count"], series
+            # text exposition: the +Inf row and the _count row of each
+            # lane must agree within one rendering
+            inf, cnt = {}, {}
+            for line in reg.prometheus_text().splitlines():
+                m = bucket_re.match(line)
+                if m:
+                    inf[m.group(1)] = int(m.group(2))
+                m = count_re.match(line)
+                if m:
+                    cnt[m.group(1)] = int(m.group(2))
+            assert inf == cnt
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
