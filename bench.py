@@ -773,6 +773,15 @@ def main():
                 else:
                     os.environ[k] = saved[k]
         parity = bool(parity_res["parity"]) if parity_res else False
+        # serde-exact ceremony traffic at the measured (n, t): the bench
+        # times the crypto phases without a hub, so the wire cost is
+        # published analytically (utils.serde.ceremony_wire_bytes — the
+        # counted transport reproduces it byte-for-byte on a fault-free
+        # run); perf_regress gates GROWTH of wire_bytes
+        from dkg_tpu.groups import host as gh
+        from dkg_tpu.utils import serde
+
+        wire_total = serde.ceremony_wire_bytes(gh.ALL_GROUPS[curve], n, t)
         # north-star + KEM children inherit the WINNING rung's flags,
         # exactly like the parity child: under pure defaults they would
         # re-enter the 16-bit device table build that has stalled on
@@ -812,6 +821,8 @@ def main():
                         "table_s": res.get("table_s"),
                         "rates_per_s": rates,
                         "pairs_sealed_per_s": seal_rate,
+                        "wire_bytes": wire_total,
+                        "bytes_per_pair": round(wire_total / (n * (n - 1)), 1),
                         "dem": {
                             "scalar_s": res.get("seal_scalar_s"),
                             "scalar_pairs": res.get("seal_scalar_pairs"),

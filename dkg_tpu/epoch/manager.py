@@ -140,6 +140,7 @@ class EpochManager:
             )
         self.max_churn = max_churn  # None = unbounded
         self.op_seq = int(ops_done)
+        self.pub_seq = 0  # party-local publish ordinal (causal-flow key)
         self.finished = False  # True once this party has left the committee
         self.quarantined = 0
         self.resumed_steps = 0
@@ -229,8 +230,18 @@ class EpochManager:
     # -- channel plumbing ---------------------------------------------------
 
     def _publish(self, round_no: int, sender: int, payload: bytes) -> None:
-        obslog.emit_current("epoch_publish", round=round_no, bytes=len(payload))
+        # same correlation key as net.party publishes: (ceremony_id,
+        # round, party, seq) — forensics and flow rendering parse the
+        # epoch and ceremony streams with one schema.  Emitted after the
+        # channel call, like net.party: the timestamp marks visibility.
+        seq = self.pub_seq
+        self.pub_seq += 1
         self.channel.publish(round_no, sender, payload)
+        obslog.emit_current(
+            "epoch_publish", round=round_no, bytes=len(payload), seq=seq
+        )
+        if self.trace is not None:
+            self.trace.bump("net.wire_bytes_out", len(payload))
 
     def _fetch(self, round_no: int, expected: int, mask) -> dict[int, bytes]:
         """Fetch one epoch round; with a replayed present ``mask`` the
@@ -249,9 +260,13 @@ class EpochManager:
             got = self.channel.fetch(round_no, len(mask), timeout)
             return {j: got[j] for j in mask if j in got}
         got = self.channel.fetch(round_no, expected, timeout)
+        if self.trace is not None:
+            self.trace.bump(
+                "net.wire_bytes_in", sum(len(v) for v in got.values())
+            )
         obslog.emit_current(
             "epoch_tail", round=round_no, present=len(got),
-            timed_out=len(got) < expected,
+            senders=sorted(got), timed_out=len(got) < expected,
         )
         return got
 

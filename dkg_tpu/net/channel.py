@@ -48,12 +48,18 @@ import time
 from typing import Optional, Protocol
 
 from ..utils import envknobs, obslog
-from ..utils.metrics import REGISTRY
+from ..utils.metrics import REGISTRY, SIZE_BUCKETS
 
 _OP_PUB = 1
 _OP_FETCH = 2
 _OP_EVID = 3
 _OP_NAMES = {_OP_PUB: "publish", _OP_FETCH: "fetch", _OP_EVID: "evidence"}
+
+# Largest payload the length-prefixed wire format can carry: lengths are
+# packed as little-endian u32 (`<I`/`<III`), so anything bigger must be
+# rejected BEFORE packing — struct.error at pack time is opaque and, on
+# the hub reply path, would tear the frame mid-stream.
+WIRE_MAX_PAYLOAD = 0xFFFFFFFF
 
 # How many distinct payloads (the original + alternates) to retain per
 # equivocating (round, sender) as evidence before only counting.
@@ -92,6 +98,69 @@ class TruncatedStream(TransportError):
 
 class RetryBudgetExceeded(TransportError):
     """All RPC attempts failed; carries the last underlying error."""
+
+
+class PayloadTooLarge(TransportError):
+    """A payload exceeds the u32 length prefix of the wire format.
+
+    Raised BEFORE packing (client publish and hub reply paths both
+    guard), carrying the offending size — retrying cannot help, but the
+    typed error lets callers distinguish "your message is impossible"
+    from a transient socket fault."""
+
+    def __init__(self, size: int, where: str) -> None:
+        super().__init__(
+            f"payload of {size} bytes exceeds the u32 wire limit "
+            f"({WIRE_MAX_PAYLOAD}) at {where}"
+        )
+        self.size = size
+        self.where = where
+
+
+def _check_wire_size(size: int, where: str) -> None:
+    if size > WIRE_MAX_PAYLOAD:
+        raise PayloadTooLarge(size, where)
+
+
+# -- counted wire helpers -----------------------------------------------------
+#
+# EVERY socket send and receive in this module flows through these (lint
+# rule DKG012 pins that), so `net_wire_bytes_total{dir,op}` is the
+# ground truth of what the data plane moved — the number ROADMAP item 4
+# (constant-size commitments) must shrink.
+
+
+def _count_wire(direction: str, op: str, n: int) -> None:
+    REGISTRY.inc("net_wire_bytes_total", n, dir=direction, op=op)
+
+
+def _observe_payload(op: str, n: int) -> None:
+    """Per-message-type payload-size histogram (op distinguishes the
+    message family, e.g. publish vs fetch reply entries)."""
+    REGISTRY.observe("net_wire_payload_bytes", n, buckets=SIZE_BUCKETS, op=op)
+
+
+def _wire_send(sock: socket.socket, data: bytes, op: str) -> None:
+    """The counted send: the only sanctioned ``sendall`` in dkg_tpu/net/
+    outside the WAL (DKG012)."""
+    sock.sendall(data)
+    _count_wire("out", op, len(data))
+
+
+class _CountedReader:
+    """File-like read wrapper counting bytes drained off a socket; the
+    total is flushed into ``net_wire_bytes_total{dir="in"}`` by the RPC
+    core once the reply is fully consumed."""
+
+    def __init__(self, f) -> None:
+        self._f = f
+        self.n = 0
+
+    def read(self, n: int) -> bytes:
+        chunk = self._f.read(n)
+        if chunk:
+            self.n += len(chunk)
+        return chunk
 
 
 class BroadcastChannel(Protocol):
@@ -167,6 +236,7 @@ class _HubHandler(socketserver.StreamRequestHandler):
             if op == _OP_PUB:
                 round_no, sender, ln = struct.unpack("<III", _read_exact(self.rfile, 12))
                 payload = _read_exact(self.rfile, ln)
+                _observe_payload("hub_publish", ln)
                 hub.channel.publish(round_no, sender, payload)
                 self.wfile.write(_ACK_OK)
                 hub._observe_rpc("publish", time.perf_counter() - t0, 13 + ln, 1)
@@ -177,6 +247,12 @@ class _HubHandler(socketserver.StreamRequestHandler):
                 got = hub.channel.fetch(round_no, expected, timeout_ms / 1000.0)
                 out = [struct.pack("<I", len(got))]
                 for sender, payload in sorted(got.items()):
+                    # hub reply path: guard BEFORE packing — a payload
+                    # that slipped past the client guard (e.g. published
+                    # straight into the backing InProcessChannel) must
+                    # not tear the reply frame mid-stream
+                    _check_wire_size(len(payload), "hub fetch reply")
+                    _observe_payload("hub_fetch", len(payload))
                     out.append(struct.pack("<II", sender, len(payload)))
                     out.append(payload)
                 reply = b"".join(out)
@@ -275,6 +351,11 @@ class TcpHub:
         REGISTRY.observe("dkg_hub_rpc_seconds", dt, op=op)
         REGISTRY.inc("dkg_hub_bytes_total", n_in, direction="in")
         REGISTRY.inc("dkg_hub_bytes_total", n_out, direction="out")
+        # the hub's share of the wire ledger: ops are prefixed so the
+        # client and hub contributions of one in-process test never
+        # merge into a double-counted series
+        _count_wire("in", f"hub_{op}", n_in)
+        _count_wire("out", f"hub_{op}", n_out)
         if self.obs is not None:
             self.obs.emit("hub_rpc", op=op, dur_s=dt, bytes_in=n_in, bytes_out=n_out)
 
@@ -364,7 +445,12 @@ class TcpHubChannel:
     # -- retrying RPC core --------------------------------------------------
 
     def _rpc(
-        self, payload: bytes, read_reply, io_timeout: float, budget_clamp: bool = True
+        self,
+        payload: bytes,
+        read_reply,
+        io_timeout: float,
+        budget_clamp: bool = True,
+        op: str = "rpc",
     ) -> object:
         """One RPC with retries.  With ``budget_clamp`` (every RPC except
         ``fetch``, which pre-clamps its hub-side wait itself) the
@@ -386,9 +472,16 @@ class TcpHubChannel:
                     )
                 self.stats["retries"] += 1
                 REGISTRY.inc("dkg_client_rpc_retries_total")
-                obslog.emit_current("rpc_retry", attempt=attempt, error=repr(last))
                 step = min(_BACKOFF_CAP_S, self._backoff_s * (2 ** (attempt - 1)))
-                time.sleep(step * (0.5 + self._rng.random()))
+                backoff = step * (0.5 + self._rng.random())
+                # backoff_s makes retry time attributable: forensics
+                # (obslog.critical_path) charges it to the retry bucket
+                # instead of leaving it inside the transport residual
+                obslog.emit_current(
+                    "rpc_retry", attempt=attempt, error=repr(last),
+                    backoff_s=backoff, op=op,
+                )
+                time.sleep(backoff)
             timeout = io_timeout
             if budget_clamp and remaining is not None:
                 clamped = min(io_timeout, max(remaining, _POST_BUDGET_IO_FLOOR_S))
@@ -399,9 +492,12 @@ class TcpHubChannel:
                     timeout = clamped
             try:
                 with socket.create_connection(self._addr, timeout=timeout) as s:
-                    s.sendall(payload)
-                    f = s.makefile("rb")
-                    return read_reply(f)
+                    _wire_send(s, payload, op)
+                    f = _CountedReader(s.makefile("rb"))
+                    try:
+                        return read_reply(f)
+                    finally:
+                        _count_wire("in", op, f.n)
             except (OSError, TransportError) as exc:
                 last = exc
         raise RetryBudgetExceeded(
@@ -409,8 +505,12 @@ class TcpHubChannel:
         )
 
     def publish(self, round_no: int, sender: int, payload: bytes) -> None:
+        # guard BEFORE packing: an oversized payload must die as a typed
+        # error carrying its size, not as an opaque struct.error
+        _check_wire_size(len(payload), "client publish")
+        _observe_payload("publish", len(payload))
         msg = bytes([_OP_PUB]) + struct.pack("<III", round_no, sender, len(payload)) + payload
-        self._rpc(msg, _read_ack, self._io_timeout_s)
+        self._rpc(msg, _read_ack, self._io_timeout_s, op="publish")
 
     def fetch(self, round_no: int, expected: int, timeout: float = 30.0) -> dict[int, bytes]:
         remaining = self._budget_remaining()
@@ -430,13 +530,17 @@ class TcpHubChannel:
             for _ in range(count):
                 sender, ln = struct.unpack("<II", _read_exact(f, 8))
                 out[sender] = _read_exact(f, ln)
+                _observe_payload("fetch", ln)
             return out
 
         # The hub blocks up to ``timeout`` before replying, so the socket
         # deadline must cover the wait *plus* normal I/O slack; the hub
         # wait was already clamped (and counted) above, so _rpc must not
         # clamp — or double-count — again.
-        return self._rpc(msg, read_reply, timeout + self._io_timeout_s, budget_clamp=False)
+        return self._rpc(
+            msg, read_reply, timeout + self._io_timeout_s,
+            budget_clamp=False, op="fetch",
+        )
 
     def equivocation_counts(self) -> dict[tuple[int, int], int]:
         """(round, sender) -> number of distinct payloads the hub saw
@@ -451,4 +555,4 @@ class TcpHubChannel:
                 out[(round_no, sender)] = n
             return out
 
-        return self._rpc(msg, read_reply, self._io_timeout_s)
+        return self._rpc(msg, read_reply, self._io_timeout_s, op="evidence")

@@ -68,12 +68,19 @@ _KIND_CODES = {
 }
 
 
-def _note_fault(kind: str, round_no: int, sender: int) -> None:
+def _note_fault(
+    kind: str, round_no: int, sender: int, seconds: Optional[float] = None
+) -> None:
     """Every injected fault is observable: a per-kind counter plus a
     flight-recorder event in the victim party's log, so a chaos failure
-    can be replayed from its logs alone (module docstring)."""
+    can be replayed from its logs alone (module docstring).  Delay
+    faults carry their injected ``seconds`` so forensics can attribute
+    the lost wall-clock (obslog.critical_path)."""
     REGISTRY.inc("dkg_faults_injected_total", kind=kind)
-    obslog.emit_current("fault_injected", round=round_no, fault=kind, sender=sender)
+    obslog.emit_current(
+        "fault_injected", round=round_no, fault=kind, sender=sender,
+        seconds=seconds,
+    )
 
 
 class CrashFault(RuntimeError):
@@ -262,7 +269,10 @@ class FaultyChannel:
         plan = self._plan
         publishes = [payload]
         for kind, arg in plan.faults_for(round_no, sender):
-            _note_fault(kind, round_no, sender)
+            _note_fault(
+                kind, round_no, sender,
+                seconds=float(arg) if kind == "delay" else None,  # type: ignore[arg-type]
+            )
             if kind == "drop":
                 return
             elif kind == "delay":
@@ -529,7 +539,19 @@ def run_epochs_with_faults(
                     keys[i], pks, rng,
                     timeout=timeout, checkpoint=wal, max_churn=None,
                 )
-                ops(mgr, out, founding=True)
+                # run_party's recorder is scoped to the ceremony; the
+                # epoch ops need their own ambient binding or every
+                # epoch_* emit is a no-op.  Same ceremony id, so the
+                # per-party JSONL carries one merged stream.
+                obs = obslog.from_env(
+                    ceremony_id=obslog.ceremony_id_for(env), party=i + 1
+                )
+                try:
+                    with obslog.use(obs):
+                        ops(mgr, out, founding=True)
+                finally:
+                    if obs is not None:
+                        obs.close()
                 out.resumes = max(out.resumes, incarnation)
                 return
             except RestartFault:
@@ -571,7 +593,15 @@ def run_epochs_with_faults(
                     checkpoint=wal, max_churn=None,
                     ops_done=refreshes,
                 )
-                ops(mgr, out, founding=False)
+                obs = obslog.from_env(
+                    ceremony_id=obslog.ceremony_id_for(env), party=party_id
+                )
+                try:
+                    with obslog.use(obs):
+                        ops(mgr, out, founding=False)
+                finally:
+                    if obs is not None:
+                        obs.close()
                 out.resumes = max(out.resumes, incarnation)
                 return
             except RestartFault:

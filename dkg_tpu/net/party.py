@@ -162,18 +162,28 @@ class _FetchOutcome:
     timed_out: bool
 
 
-def _publish(channel, round_no: int, my: int, payload: Optional[bytes]) -> None:
+def _publish(
+    channel,
+    round_no: int,
+    my: int,
+    payload: Optional[bytes],
+    *,
+    seq: Optional[int] = None,
+    trace: Optional[CeremonyTrace] = None,
+) -> None:
     # flight-recorder events carry LENGTHS only, never payload bytes —
-    # round 1/5 payloads hold encrypted shares and disclosures
-    obslog.emit_current("publish", round=round_no, bytes=len(payload or b""))
-    channel.publish(round_no, my, payload or b"")
-
-
-def _drain(channel, my: int, start_round: int, result: PartyResult) -> PartyResult:
-    """Publish empties for the remaining rounds so peers don't block."""
-    for r in range(start_round, 6):
-        _publish(channel, r, my, b"")
-    return result
+    # round 1/5 payloads hold encrypted shares and disclosures.  ``seq``
+    # is the party-local publish ordinal: together with the stamped
+    # (ceremony_id, round, party) it is the correlation key fetch-side
+    # events reference (docs/observability.md, "Causal flows").  Emitted
+    # AFTER the channel call so the timestamp marks when the payload
+    # became visible to peers — critical_path charges the straggler leg
+    # up to this instant, and flow arrows always point forward in time.
+    data = payload or b""
+    channel.publish(round_no, my, data)
+    obslog.emit_current("publish", round=round_no, bytes=len(data), seq=seq)
+    if trace is not None:
+        trace.bump("net.wire_bytes_out", len(data))
 
 
 class _PartyRun:
@@ -199,8 +209,16 @@ class _PartyRun:
         self.prev = None  # decoded messages the next head consumes
         self.last_outcome: Optional[_FetchOutcome] = None
         self.finished = False
+        self.pub_seq = 0  # party-local publish ordinal (causal-flow key)
 
     # -- shared plumbing ----------------------------------------------------
+
+    def _pub(self, round_no: int, payload: Optional[bytes]) -> None:
+        seq = self.pub_seq
+        self.pub_seq += 1
+        _publish(
+            self.channel, round_no, self.my, payload, seq=seq, trace=self.trace
+        )
 
     def _decode_list(self, round_no: int, got: dict[int, bytes], counting: bool):
         decoder, validate, wrap = _ROUNDS[round_no]
@@ -230,10 +248,15 @@ class _PartyRun:
         self.last_outcome = _FetchOutcome(
             tuple(sorted(got)), self.result.quarantined - q0, timed_out
         )
+        if self.trace is not None:
+            self.trace.bump(
+                "net.wire_bytes_in", sum(len(v) for v in got.values())
+            )
         obslog.emit_current(
             "round_tail",
             round=round_no,
             present=len(got),
+            senders=sorted(got),
             quarantined_delta=self.result.quarantined - q0,
             timed_out=timed_out,
         )
@@ -266,7 +289,9 @@ class _PartyRun:
         # error KIND only — DkgError bodies can reference protocol state
         obslog.emit_current("abort", error=err.kind.name, drain_from=drain_from)
         self.result.error = err
-        _drain(self.channel, self.my, drain_from, self.result)
+        # publish empties for the remaining rounds so peers never block
+        for r in range(drain_from, 6):
+            self._pub(r, b"")
         self.finished = True
 
     def _finish(self) -> PartyResult:
@@ -303,7 +328,7 @@ class _PartyRun:
         )
         p1 = serde.encode_phase1(self.group, b1)
         self._record(1, p1, phase=phase1)
-        _publish(self.channel, 1, self.my, p1)
+        self._pub(1, p1)
         self.phase = phase1
 
     def _head2(self) -> None:
@@ -314,11 +339,11 @@ class _PartyRun:
             # terminal record before publishing (crash mid-drain must
             # not recompute the proofs with a fresh rng)
             self._record(2, p2, error=nxt, drain_from=3)
-            _publish(self.channel, 2, self.my, p2)
+            self._pub(2, p2)
             self._abort(nxt, 3)
             return
         self._record(2, p2, phase=nxt)
-        _publish(self.channel, 2, self.my, p2)
+        self._pub(2, p2)
         self.phase = nxt
 
     def _head3(self) -> None:
@@ -329,7 +354,7 @@ class _PartyRun:
             return
         p3 = serde.encode_phase3(self.group, b3) if b3 else b""
         self._record(3, p3, phase=nxt)
-        _publish(self.channel, 3, self.my, p3)
+        self._pub(3, p3)
         self.phase = nxt
 
     def _head4(self) -> None:
@@ -337,11 +362,11 @@ class _PartyRun:
         p4 = serde.encode_phase4(self.group, b4) if b4 else b""
         if isinstance(nxt, DkgError):
             self._record(4, p4, error=nxt, drain_from=5)
-            _publish(self.channel, 4, self.my, p4)
+            self._pub(4, p4)
             self._abort(nxt, 5)
             return
         self._record(4, p4, phase=nxt)
-        _publish(self.channel, 4, self.my, p4)
+        self._pub(4, p4)
         self.phase = nxt
 
     def _head5(self) -> None:
@@ -349,11 +374,11 @@ class _PartyRun:
         p5 = serde.encode_phase5(self.group, b5) if b5 else b""
         if isinstance(nxt, DkgError):
             self._record(5, p5, error=nxt, drain_from=6)
-            _publish(self.channel, 5, self.my, p5)
+            self._pub(5, p5)
             self._abort(nxt, 6)
             return
         self._record(5, p5, phase=nxt)
-        _publish(self.channel, 5, self.my, p5)
+        self._pub(5, p5)
         self.phase = nxt
 
     def _finalise(self) -> None:
@@ -463,7 +488,7 @@ class _PartyRun:
             # and delivers the exact recorded bytes for a publish the
             # crash interrupted
             for rec in records:
-                _publish(self.channel, rec.round_no, self.my, rec.payload)
+                self._pub(rec.round_no, rec.payload)
             last = records[-1]
             if last.error is not None:
                 self._abort(last.error, last.drain_from)

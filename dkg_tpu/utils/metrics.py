@@ -36,6 +36,14 @@ DEFAULT_BUCKETS = (
     0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
 )
 
+# Payload-size buckets (bytes): empty-round publishes (~13 B framed) up
+# to north-star round-1 dealings (tens of MB).  Fixed for the same
+# aggregation reason as DEFAULT_BUCKETS — wire-accounting histograms
+# from different processes must merge.
+SIZE_BUCKETS = (
+    64, 256, 1024, 4096, 16384, 65536, 262144, 1048576, 4194304, 16777216,
+)
+
 
 def _labelitems(labels: dict) -> tuple:
     return tuple(

@@ -38,10 +38,12 @@ per party, ``phase_span`` spans as complete ("X") slices with
 from __future__ import annotations
 
 import contextvars
+import gzip
 import json
 import os
 import threading
 import time
+import zlib
 from collections import deque
 from typing import Any, Iterable
 
@@ -235,24 +237,142 @@ def from_env(
     return ObsLog(capacity=capacity, path=path, ceremony_id=ceremony_id, party=party)
 
 
+# -- event schema ------------------------------------------------------------
+
+#: The pinned flight-recorder event schema (docs/observability.md, "Event
+#: schema").  Every event carries the base fields ``ts``/``mono``/``kind``
+#: (plus ``ceremony_id``/``party``/``round`` when bound); per-kind entries
+#: list the REQUIRED payload fields and the OPTIONAL extras.  ``None`` for
+#: the optional set marks an open kind (runtimeobs and service events whose
+#: payloads vary by probe).  scripts/forensics.py and to_chrome_trace parse
+#: exactly this schema — tests/test_obslog.py conformance-checks a live
+#: ceremony's stream against it, so an emit-site drift fails loudly.
+EVENT_SCHEMA: dict[str, dict[str, tuple | None]] = {
+    # ceremony data plane (net.party / net.channel / net.faults)
+    "round_head": {"required": ("round",), "optional": ()},
+    "publish": {"required": ("round", "bytes", "seq"), "optional": ()},
+    "round_tail": {
+        "required": (
+            "round", "present", "senders", "quarantined_delta", "timed_out",
+        ),
+        "optional": (),
+    },
+    "quarantine": {"required": ("round", "peer"), "optional": ()},
+    "rpc_retry": {
+        "required": ("attempt", "error", "backoff_s", "op"), "optional": (),
+    },
+    "budget_clamp": {"required": ("where", "timeout_s"), "optional": ("round",)},
+    "fault_injected": {
+        "required": ("round", "fault", "sender"), "optional": ("seconds",),
+    },
+    "abort": {"required": ("error", "drain_from"), "optional": ()},
+    "party_done": {
+        "required": (
+            "ok", "quarantined", "timeouts", "retries", "resumes",
+            "wal_records", "replayed_rounds",
+        ),
+        "optional": (),
+    },
+    # durability (net.checkpoint via net.party)
+    "wal_record": {"required": ("round", "bytes", "terminal"), "optional": ()},
+    "wal_resume": {"required": ("replayed_rounds",), "optional": ()},
+    # epoch data plane (epoch.manager) — publish/tail mirror the ceremony
+    # kinds field-for-field so forensics parses one format
+    "epoch_head": {
+        "required": ("round", "op", "step", "op_kind"), "optional": (),
+    },
+    "epoch_publish": {"required": ("round", "bytes", "seq"), "optional": ()},
+    "epoch_tail": {
+        "required": ("round", "present", "senders", "timed_out"),
+        "optional": (),
+    },
+    "epoch_quarantine": {"required": ("round", "peer"), "optional": ()},
+    "epoch_wal_record": {"required": ("op", "step", "bytes"), "optional": ()},
+    "epoch_done": {
+        "required": ("op", "op_kind", "status"), "optional": ("epoch",),
+    },
+    # hub side (net.channel TcpHub)
+    "hub_rpc": {
+        "required": ("op", "dur_s", "bytes_in", "bytes_out"), "optional": (),
+    },
+    "hub_junk_frame": {"required": ("reason",), "optional": ("op",)},
+    # spans (tracing.phase_span / service scheduler)
+    "span": {
+        "required": ("name", "ts0", "mono0", "dur_s"), "optional": ("subs",),
+    },
+    # open kinds: payload varies by probe/deployment (utils.runtimeobs,
+    # dkg_tpu.service) — base-field conformance only
+    "jax_compile": {"required": (), "optional": None},
+    "counter_sample": {"required": (), "optional": None},
+    "jax_cost_probe": {"required": (), "optional": None},
+    "http_error": {"required": (), "optional": None},
+    "service_fault_injected": {"required": (), "optional": None},
+}
+
+#: Base fields every event may carry regardless of kind.
+_SCHEMA_BASE = ("ts", "mono", "kind", "ceremony_id", "party", "round")
+
+
+def validate_events(
+    events: Iterable[dict], *, allow_unknown: bool = False
+) -> list[str]:
+    """Check events against :data:`EVENT_SCHEMA`; returns a list of
+    human-readable problems (empty = conformant).  Unknown kinds are
+    errors unless ``allow_unknown`` (service deployments add their own
+    ``service_*`` kinds); ``None``-valued fields satisfy presence (e.g.
+    ``fault_injected.seconds`` for non-delay faults)."""
+    problems: list[str] = []
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            problems.append(f"event #{i}: not a dict")
+            continue
+        kind = ev.get("kind")
+        where = f"event #{i} ({kind!r})"
+        for base in ("ts", "mono", "kind"):
+            if base not in ev:
+                problems.append(f"{where}: missing base field {base!r}")
+        spec = EVENT_SCHEMA.get(kind) if isinstance(kind, str) else None
+        if spec is None:
+            if not allow_unknown:
+                problems.append(f"{where}: unknown kind")
+            continue
+        for req in spec["required"]:
+            if req not in ev:
+                problems.append(f"{where}: missing required field {req!r}")
+        optional = spec["optional"]
+        if optional is None:
+            continue  # open kind: any extras allowed
+        allowed = set(_SCHEMA_BASE) | set(spec["required"]) | set(optional)
+        for k in ev:
+            if k not in allowed:
+                problems.append(f"{where}: unexpected field {k!r}")
+    return problems
+
+
 # -- timeline export ---------------------------------------------------------
 
 
 def load_jsonl(path: str | os.PathLike) -> list[dict]:
     """Events from one JSONL log; malformed lines are skipped (a crash
-    mid-write must not poison the whole timeline)."""
+    mid-write must not poison the whole timeline).  ``.gz`` paths are
+    read through gzip — chaos/fleet runs compress their sinks."""
+    p = os.fspath(path)
+    opener = gzip.open if p.endswith(".gz") else open
     out: list[dict] = []
-    with open(path, "r", encoding="utf-8") as fh:
-        for line in fh:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                ev = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if isinstance(ev, dict):
-                out.append(ev)
+    with opener(p, "rt", encoding="utf-8") as fh:
+        try:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except (json.JSONDecodeError, UnicodeDecodeError):
+                    continue
+                if isinstance(ev, dict):
+                    out.append(ev)
+        except (EOFError, OSError, zlib.error):
+            pass  # torn gzip tail: keep every line that decoded
     return out
 
 
@@ -395,4 +515,227 @@ def to_chrome_trace(events: Iterable[dict]) -> dict:
                     "args": args,
                 }
             )
+    trace.extend(_flow_events(events, pids, t0))
     return {"traceEvents": trace, "displayTimeUnit": "ms"}
+
+
+def _flow_events(events: list[dict], pids: dict[str, int], t0: float) -> list[dict]:
+    """Synthesize Perfetto flow events (``ph: s/f``) linking each publish
+    to every fetch of it: a ``round_tail``/``epoch_tail`` lists the
+    ``senders`` it received, so one start anchored at the publish plus
+    one finish per fetching tail renders the (ceremony_id, round,
+    sender, seq) correlation key as arrows in the timeline.  Synthesized
+    at export time — live emission would cost O(n^2) events per round."""
+    pubkinds = {"publish": "round_tail", "epoch_publish": "epoch_tail"}
+    # (cid, tailkind, round, party) -> publish event; first wins, matching
+    # the channel's first-publish-wins semantics (resume republishes)
+    pubs: dict[tuple, dict] = {}
+    for ev in events:
+        tailkind = pubkinds.get(ev.get("kind"))
+        if tailkind is None or not isinstance(ev.get("party"), int):
+            continue
+        key = (
+            str(ev.get("ceremony_id", "proc")), tailkind, ev.get("round"),
+            ev["party"],
+        )
+        pubs.setdefault(key, ev)
+    out: list[dict] = []
+    for ev in events:
+        if ev.get("kind") not in ("round_tail", "epoch_tail"):
+            continue
+        cid = str(ev.get("ceremony_id", "proc"))
+        for sender in ev.get("senders") or ():
+            pub = pubs.get((cid, ev["kind"], ev.get("round"), sender))
+            if pub is None:
+                continue  # log set missing this publisher's sink
+            # one flow (unique id) per (publish, fetcher) pair — a chrome
+            # flow id binds exactly one start to one finish
+            fid = (
+                f"{cid}:{ev['kind']}:{ev.get('round')}:{sender}"
+                f":{pub.get('seq')}->{ev.get('party')}"
+            )
+            common = {
+                "name": f"r{ev.get('round')} publish p{sender}",
+                "cat": "flow",
+                "pid": pids[cid],
+                "id": fid,
+            }
+            out.append(
+                {
+                    **common,
+                    "ph": "s",
+                    "tid": _tid(pub),
+                    "ts": (pub.get("ts", 0.0) - t0) * 1e6,
+                }
+            )
+            out.append(
+                {
+                    **common,
+                    "ph": "f",
+                    "bp": "e",
+                    "tid": _tid(ev),
+                    "ts": (ev.get("ts", 0.0) - t0) * 1e6,
+                }
+            )
+    return out
+
+
+# -- critical-path forensics -------------------------------------------------
+
+
+def _round_windows(evs: list[dict]) -> dict[int, dict]:
+    """Per-round raw material for one ceremony's merged event list:
+    head/tail/publish timestamps plus the per-party retry and
+    injected-delay attributions."""
+    rounds: dict[int, dict] = {}
+
+    def bucket(r) -> dict | None:
+        if not isinstance(r, int):
+            return None
+        return rounds.setdefault(
+            r, {"heads": [], "tails": [], "pubs": {}, "timed_out": False}
+        )
+
+    for ev in evs:
+        kind = ev.get("kind")
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)):
+            continue
+        if kind == "round_head":
+            b = bucket(ev.get("round"))
+            if b is not None:
+                b["heads"].append(ts)
+        elif kind == "round_tail":
+            b = bucket(ev.get("round"))
+            if b is not None:
+                b["tails"].append(ev)
+                if ev.get("timed_out"):
+                    b["timed_out"] = True
+        elif kind == "publish":
+            b = bucket(ev.get("round"))
+            party = ev.get("party")
+            if b is not None and isinstance(party, int):
+                # first-publish-wins, matching the channel semantics
+                b["pubs"].setdefault(party, ts)
+    return rounds
+
+
+def _attributed(
+    evs: list[dict], party, lo: float, hi: float, round_no: int
+) -> tuple[float, float]:
+    """(retry_s, fault_s) chargeable to ``party`` inside the wall-clock
+    window [lo, hi]: recorded RPC backoff sleeps plus injected delay
+    faults for this round.  Each sum is clamped to the window width —
+    attribution can never exceed the time it is explaining."""
+    width = max(0.0, hi - lo)
+    retry = fault = 0.0
+    for ev in evs:
+        if ev.get("party") != party:
+            continue
+        kind = ev.get("kind")
+        ts = ev.get("ts", 0.0)
+        if kind == "rpc_retry" and lo <= ts <= hi:
+            retry += float(ev.get("backoff_s") or 0.0)
+        elif (
+            kind == "fault_injected"
+            and ev.get("fault") == "delay"
+            and ev.get("round") == round_no
+            and ev.get("seconds") is not None
+        ):
+            fault += float(ev.get("seconds"))
+    retry = min(retry, width)
+    fault = min(fault, width - retry)
+    return retry, fault
+
+
+def critical_path(events: Iterable[dict], registry=None) -> list[dict]:
+    """Reconstruct each ceremony round's barrier and attribute it.
+
+    Merges any number of per-party logs (wall-clock ``ts`` aligns them,
+    as in :func:`to_chrome_trace`) and reports, per (ceremony_id, round):
+
+    * ``barrier_s`` — first ``round_head`` to last ``round_tail``;
+    * ``straggler`` — the last party to publish (or the absent party a
+      timed-out round waited for), with ``straggler_lag_s`` = how long
+      the round waited for it (round open -> its publish);
+    * a decomposition ``compute_s + transport_s + retry_s +
+      quarantine_s == barrier_s`` **exactly** (the buckets partition the
+      barrier by construction): the leg up to the straggler's publish is
+      compute time net of its recorded retries and injected delays, the
+      leg after it is fetch/transport time net of the closing fetcher's
+      retries; retry backoffs land in ``retry_s``, injected-fault delays
+      and time spent waiting on an absent (crashed/timed-out) straggler
+      land in ``quarantine_s``.
+
+    ``registry`` (a MetricsRegistry) receives one
+    ``net_round_straggler_lag_seconds{ceremony_id,round,straggler}``
+    gauge per round for the SLO layer.  scripts/forensics.py is the CLI.
+    """
+    by_cid: dict[str, list[dict]] = {}
+    for ev in events:
+        if isinstance(ev, dict):
+            by_cid.setdefault(str(ev.get("ceremony_id", "proc")), []).append(ev)
+    report: list[dict] = []
+    for cid in sorted(by_cid):
+        evs = by_cid[cid]
+        committee = {
+            ev["party"] for ev in evs if isinstance(ev.get("party"), int)
+        }
+        for r, b in sorted(_round_windows(evs).items()):
+            if not b["tails"]:
+                continue  # round never closed anywhere: no barrier to explain
+            t_close = max(ev["ts"] for ev in b["tails"])
+            closer = max(b["tails"], key=lambda ev: ev["ts"]).get("party")
+            opens = b["heads"] or list(b["pubs"].values())
+            if not opens:
+                continue
+            t_open = min(opens)
+            barrier = max(0.0, t_close - t_open)
+            absent = sorted(committee - set(b["pubs"]))
+            if b["pubs"]:
+                last_pub = max(b["pubs"], key=lambda p: b["pubs"][p])
+            else:
+                last_pub = None
+            if absent and b["timed_out"]:
+                # the round closed on timeout waiting for a party that
+                # never published: IT is the straggler, and the whole
+                # wait is chargeable to its absence
+                straggler, s_absent, pub_ts = absent[0], True, t_close
+            elif last_pub is None:
+                continue
+            else:
+                straggler, s_absent = last_pub, False
+                pub_ts = min(max(b["pubs"][last_pub], t_open), t_close)
+            # leg 1: round open -> straggler publish (its compute path)
+            retry1, fault1 = _attributed(evs, straggler, t_open, pub_ts, r)
+            leg1 = pub_ts - t_open
+            resid1 = max(0.0, leg1 - retry1 - fault1)
+            # leg 2: straggler publish -> slowest fetcher's close
+            retry2, fault2 = _attributed(evs, closer, pub_ts, t_close, r)
+            leg2 = t_close - pub_ts
+            resid2 = max(0.0, leg2 - retry2 - fault2)
+            entry = {
+                "ceremony_id": cid,
+                "round": r,
+                "barrier_s": barrier,
+                "straggler": straggler,
+                "straggler_absent": s_absent,
+                "straggler_lag_s": leg1,
+                "compute_s": 0.0 if s_absent else resid1,
+                "transport_s": resid2,
+                "retry_s": retry1 + retry2,
+                "quarantine_s": fault1 + fault2 + (resid1 if s_absent else 0.0),
+                "timed_out": b["timed_out"],
+                "present": max(ev.get("present", 0) for ev in b["tails"]),
+                "expected": len(committee),
+            }
+            report.append(entry)
+            if registry is not None:
+                registry.set_gauge(
+                    "net_round_straggler_lag_seconds",
+                    leg1,
+                    ceremony_id=cid,
+                    round=r,
+                    straggler=straggler,
+                )
+    return report

@@ -161,6 +161,46 @@ def _r_proof(r: Reader) -> bc.ProofOfMisbehaviour:
     )
 
 
+def phase1_wire_bytes(group: HostGroup, n: int, t: int) -> int:
+    """Exact encoded size of one fault-free ``BroadcastPhase1`` for
+    (group, n, t) — the analytic twin of :func:`encode_phase1`, kept in
+    byte-lockstep with it by tests/test_serde.py.  Wire accounting uses
+    it where no channel exists (bench.py runs the crypto phases only)
+    and to cross-check the counted live path."""
+    point = len(group.encode(group.identity()))
+    scalar = group.scalar_field.nbytes
+    # HybridCiphertext: e1 point + u32-length-prefixed stream ciphertext
+    # (ChaCha20: ciphertext length == plaintext scalar length)
+    hybrid = point + 4 + scalar
+    # u16 coeff count + (t+1) commitment points, then u16 share count +
+    # n entries of (u16 recipient + share ct + randomness ct)
+    return 2 + (t + 1) * point + 2 + n * (2 + 2 * hybrid)
+
+
+def phase3_wire_bytes(group: HostGroup, n: int, t: int) -> int:
+    """Exact encoded size of one ``BroadcastPhase3`` (the bare
+    commitments every qualified dealer reveals): u16 count + (t+1)
+    points.  Published by every party in every ceremony, faults or
+    not."""
+    point = len(group.encode(group.identity()))
+    return 2 + (t + 1) * point
+
+
+def party_wire_bytes(group: HostGroup, n: int, t: int) -> int:
+    """Payload bytes ONE party publishes across a fault-free ceremony:
+    its phase-1 dealing plus its phase-3 bare commitments; rounds 2, 4,
+    and 5 publish empty payloads (no complaints, no disclosures)."""
+    return phase1_wire_bytes(group, n, t) + phase3_wire_bytes(group, n, t)
+
+
+def ceremony_wire_bytes(group: HostGroup, n: int, t: int) -> int:
+    """Total payload bytes PUBLISHED across one fault-free ceremony (all
+    n parties).  Framing/RPC overhead is excluded — this is the payload
+    number ``net.wire_bytes_out`` sums to across the committee, and what
+    bench.py/fleet_bench.py report as ``wire_bytes``."""
+    return n * party_wire_bytes(group, n, t)
+
+
 def encode_phase1(group: HostGroup, b: bc.BroadcastPhase1) -> bytes:
     w = Writer(group)
     w.u16(len(b.committed_coefficients))

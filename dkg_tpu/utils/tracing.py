@@ -72,7 +72,31 @@ class CeremonyTrace:
         units = self.meta.get("units")
         if isinstance(units, (int, float)) and not isinstance(units, bool) and units > 0:
             out["rates_per_s"] = self.rates(units)
+        wire = self.wire_summary()
+        if wire is not None:
+            out["wire"] = wire
         return out
+
+    def wire_summary(self) -> dict | None:
+        """Per-ceremony wire totals derived from the ``net.wire_bytes_*``
+        counters the party/epoch publish-and-fetch paths bump, or None
+        when this trace saw no transport.  ``bytes_per_pair`` normalizes
+        the published payload by the n*(n-1) dealer->recipient pairs
+        (meta ``n``) — the unit the O(n*t) data-plane scaling work is
+        judged in (ROADMAP item 4)."""
+        out_b = self.counters.get("net.wire_bytes_out")
+        in_b = self.counters.get("net.wire_bytes_in")
+        if out_b is None and in_b is None:
+            return None
+        wire: dict = {
+            "wire_bytes_out": int(out_b or 0),
+            "wire_bytes_in": int(in_b or 0),
+            "wire_bytes": int(out_b or 0) + int(in_b or 0),
+        }
+        n = self.meta.get("n")
+        if isinstance(n, int) and n > 1:
+            wire["bytes_per_pair"] = (out_b or 0) / (n * (n - 1))
+        return wire
 
     def json(self) -> str:
         return json.dumps(self.as_dict(), sort_keys=True)

@@ -64,7 +64,8 @@ if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
 
 from dkg_tpu.service import buckets, engine  # noqa: E402
 from dkg_tpu.service.scheduler import CeremonyScheduler  # noqa: E402
-from dkg_tpu.utils import runtimeobs  # noqa: E402
+from dkg_tpu.groups import host as gh  # noqa: E402
+from dkg_tpu.utils import runtimeobs, serde  # noqa: E402
 from dkg_tpu.utils.metrics import REGISTRY  # noqa: E402
 
 # (n, t, count-per-1000): thresholds picked so the whole mix lands on
@@ -111,6 +112,22 @@ def build_workload(curve: str, total: int, rho_bits: int, seed: int):
         ]
     random.Random(seed).shuffle(reqs)
     return reqs
+
+
+def wire_mix(curve: str, reqs) -> dict:
+    """Serde-exact wire cost of the workload: every ceremony's traffic
+    is deterministic at its (n, t) (utils.serde.ceremony_wire_bytes),
+    so the bench publishes the totals analytically rather than running
+    the hub transport.  perf_regress gates growth of the per-ceremony
+    average — a fatter wire multiplies across the whole fleet."""
+    group = gh.ALL_GROUPS[curve]
+    total = sum(serde.ceremony_wire_bytes(group, r.n, r.t) for r in reqs)
+    pairs = sum(r.n * (r.n - 1) for r in reqs)
+    return {
+        "bytes_total": total,
+        "bytes_per_ceremony_avg": round(total / len(reqs), 1),
+        "bytes_per_pair_avg": round(total / pairs, 1),
+    }
 
 
 def warmup(runtime: engine.WarmRuntime, reqs, widths) -> float:
@@ -267,6 +284,7 @@ def main(argv=None) -> int:
         "rho_bits": args.rho_bits,
         "seed": args.seed,
         "mix": {f"{n}x{t}": c for n, t, c in MIX},
+        "wire": wire_mix(args.curve, reqs),
         "warmup_s": round(warm_s, 1),
         "service": service,
         "metrics": REGISTRY.snapshot(),

@@ -1,0 +1,122 @@
+"""Critical-path forensics over flight-recorder logs: who delayed each round?
+
+Merges the per-party JSONL logs of one or more ceremonies (written when
+``DKG_TPU_OBSLOG`` names a directory), reconstructs each round's barrier
+from its happens-before structure (every ``round_head`` opens it, the
+last ``round_tail`` closes it, publishes order the middle), and prints a
+per-round report naming the straggler party with the barrier time
+decomposed into compute / transport / retry-backoff / fault-quarantine —
+the four buckets partition the barrier exactly (obslog.critical_path).
+
+Usage::
+
+    DKG_TPU_OBSLOG=/tmp/obs python scripts/chaos_storm.py --restarts 2
+    python scripts/forensics.py /tmp/obs
+    python scripts/forensics.py '/tmp/obs/*.jsonl.gz' --json report.json
+
+Arguments may be JSONL files (optionally ``.jsonl.gz``), directories,
+or quoted glob patterns — same conventions as scripts/trace_viz.py.
+The analysis also sets one ``net_round_straggler_lag_seconds`` gauge
+per round in the process metrics REGISTRY; ``--metrics`` dumps the
+resulting exposition text so the gauges can be shipped to the SLO layer
+(scripts/slo_gate.py) without re-deriving them.
+
+Same redaction contract as the recorder itself: the report carries
+party indices, round numbers, and seconds — never payload bytes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_HERE, ".."))
+if _HERE not in sys.path:  # imported as scripts.forensics (tests)
+    sys.path.insert(1, _HERE)
+
+from dkg_tpu.utils import obslog  # noqa: E402
+from dkg_tpu.utils.metrics import REGISTRY  # noqa: E402
+from trace_viz import collect_paths  # noqa: E402
+
+
+def render(report: list[dict]) -> str:
+    """Human-readable per-round table, one block per ceremony."""
+    lines: list[str] = []
+    last_cid = None
+    for row in report:
+        if row["ceremony_id"] != last_cid:
+            last_cid = row["ceremony_id"]
+            lines.append(f"ceremony {last_cid}  "
+                         f"({row['expected']} parties)")
+            lines.append(
+                "  round  barrier_s  straggler      "
+                "compute_s  transport_s  retry_s  quarantine_s"
+            )
+        who = f"p{row['straggler']}"
+        if row["straggler_absent"]:
+            who += " (absent)"
+        flag = "  TIMED OUT" if row["timed_out"] else ""
+        lines.append(
+            f"  r{row['round']:<5} {row['barrier_s']:>9.3f}  {who:<13} "
+            f"{row['compute_s']:>9.3f}  {row['transport_s']:>11.3f}  "
+            f"{row['retry_s']:>7.3f}  {row['quarantine_s']:>12.3f}{flag}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "inputs", nargs="+",
+        help="JSONL log files, directories, or glob patterns",
+    )
+    ap.add_argument(
+        "--ceremony", default=None,
+        help="only analyse this ceremony_id (prefix match)",
+    )
+    ap.add_argument("--json", default=None, help="also write the report here")
+    ap.add_argument(
+        "--metrics", action="store_true",
+        help="print the resulting gauge exposition after the report",
+    )
+    args = ap.parse_args(argv)
+
+    paths = collect_paths(args.inputs)
+    events: list[dict] = []
+    read = 0
+    for p in paths:
+        try:
+            events.extend(obslog.load_jsonl(p))
+            read += 1
+        except OSError as exc:
+            print(f"forensics: skipping {p}: {exc}", file=sys.stderr)
+    if args.ceremony:
+        events = [
+            ev for ev in events
+            if str(ev.get("ceremony_id", "")).startswith(args.ceremony)
+        ]
+    if not events:
+        print("forensics: no events found", file=sys.stderr)
+        return 1
+
+    report = obslog.critical_path(events, registry=REGISTRY)
+    if not report:
+        print("forensics: no complete rounds in the logs", file=sys.stderr)
+        return 1
+    print(f"forensics: {len(events)} events from {read} log(s), "
+          f"{len(report)} round barriers")
+    print(render(report))
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as fh:
+            json.dump({"rounds": report}, fh, indent=2, sort_keys=True)
+        print(f"forensics: wrote {args.json}")
+    if args.metrics:
+        print(REGISTRY.prometheus_text(), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

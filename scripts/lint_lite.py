@@ -101,6 +101,13 @@ linter), so the committed baseline stays clean between CI runs:
         surface (``/metrics``, bench snapshots) cannot silently drift
         from its documentation (allowlist:
         ``_DKG011_UNDOCUMENTED_OK``)
+* DKG012  (dkg_tpu/net/ only, net/checkpoint.py exempt) raw socket I/O
+        — ``.sendall(...)`` / ``.send(...)`` / ``.recv(...)`` /
+        ``.recv_into(...)`` — outside the counted wire helpers
+        (``_wire_send`` and ``_CountedReader`` in net/channel.py):
+        every transport byte must flow through them so the
+        ``net_wire_bytes_total{dir,op}`` accounting stays exact
+        (docs/observability.md, "Wire accounting")
 
 Exit 0 = clean.  Run: ``python scripts/lint_lite.py`` (from repo root).
 Also executed by tests/test_import_hygiene.py so the default test tier
@@ -226,6 +233,16 @@ _DKG011_EMITTERS = {"inc", "observe", "set_gauge"}
 # Metric names exempt from the DKG011 docs requirement (test-only or
 # deliberately undocumented names; currently none).
 _DKG011_UNDOCUMENTED_OK: set[str] = set()
+
+# Raw socket I/O methods banned in dkg_tpu/net/ outside the counted
+# wire helpers (DKG012): bytes that bypass them are invisible to
+# net_wire_bytes_total, so the per-ceremony wire totals and the
+# perf_regress wire gate would silently under-count.
+_RAW_SOCKET_IO = {"sendall", "send", "recv", "recv_into"}
+
+# Functions sanctioned to touch sockets directly (DKG012): the counted
+# send helper and the counting reader wrapper in net/channel.py.
+_DKG012_WIRE_HELPERS = {"_wire_send", "_CountedReader"}
 
 # The same entry points banned inside loops in dkg_tpu/sign/ (DKG009):
 # a host scalar_mul per (message, signer) pair is the B·(t+1) pathology
@@ -571,6 +588,25 @@ class _Checker(ast.NodeVisitor):
                     f"raw file write ({name}) in dkg_tpu/net/ — persist "
                     "through net.checkpoint.PartyWal (atomic, fsync'd, "
                     "checksummed, 0600)",
+                )
+        # DKG012: wire accounting is load-bearing (perf gates + SLO
+        # layer read net_wire_bytes_total) — every socket send/receive
+        # in dkg_tpu/net/ must flow through the counted helpers
+        # (_wire_send / _CountedReader) so no byte escapes the meter.
+        # checkpoint.py (WAL, fd-level file IO) is out of scope.
+        if self._net_module and self.path.name != "checkpoint.py":
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in _RAW_SOCKET_IO
+                and not (set(self._func_stack) & _DKG012_WIRE_HELPERS)
+            ):
+                self._add(
+                    node,
+                    "DKG012",
+                    f"raw socket .{func.attr}() in dkg_tpu/net/ — route "
+                    "through the counted wire helpers (_wire_send / "
+                    "_CountedReader) so net_wire_bytes_total stays exact",
                 )
         # DKG006: no ad-hoc telemetry in library code — a bare print()
         # anywhere in dkg_tpu/, or a raw file write outside the

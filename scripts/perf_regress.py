@@ -200,6 +200,33 @@ def main(argv: list[str] | None = None) -> int:
             bad = 1
         else:
             print(line)
+    # wire bytes gate the OPPOSITE way from the rate gates: the serde
+    # layer makes ceremony traffic deterministic at a given (n, t), so
+    # GROWTH beyond the threshold means a protocol change silently
+    # fattened the wire — a cost the fleet pays n*(n-1) times over.
+    old_w, new_w = _cfg("wire_bytes")(old), _cfg("wire_bytes")(new)
+    if (
+        isinstance(old_w, (int, float)) and old_w > 0
+        and isinstance(new_w, (int, float)) and new_w > 0
+    ):
+        change = (new_w - old_w) / old_w
+        line = (
+            f"perf_regress: wire bytes r{old_n} {int(old_w)} -> r{new_n} "
+            f"{int(new_w)} B/ceremony ({change:+.1%})"
+        )
+        if change > args.threshold:
+            print(
+                f"{line} — WIRE GROWTH beyond {args.threshold:.0%}",
+                file=sys.stderr,
+            )
+            bad = 1
+        else:
+            print(line)
+    else:
+        print(
+            f"perf_regress: wire_bytes absent in r{old_n} or r{new_n} "
+            "— skipping the wire gate"
+        )
     # newer rounds embed a process-wide metrics snapshot alongside the
     # parsed line; acknowledge it so its presence is visibly tolerated,
     # but never gate on it (telemetry, not a benchmark)
@@ -345,6 +372,33 @@ def fleet_gate(root: pathlib.Path, threshold: float) -> int:
             bad = 1
         else:
             print(line)
+    # wire growth gates like p99: RISES are regressions (the mix is
+    # pinned by the shape keys above, so per-ceremony average traffic
+    # only moves when the protocol's wire format does)
+    old_w = (old.get("wire") or {}).get("bytes_per_ceremony_avg")
+    new_w = (new.get("wire") or {}).get("bytes_per_ceremony_avg")
+    if (
+        isinstance(old_w, (int, float)) and old_w > 0
+        and isinstance(new_w, (int, float)) and new_w > 0
+    ):
+        change = (new_w - old_w) / old_w
+        line = (
+            f"perf_regress: fleet wire r{old_n} {old_w:.0f} -> "
+            f"r{new_n} {new_w:.0f} B/ceremony ({change:+.1%})"
+        )
+        if change > threshold:
+            print(
+                f"{line} — WIRE GROWTH beyond {threshold:.0%}",
+                file=sys.stderr,
+            )
+            bad = 1
+        else:
+            print(line)
+    else:
+        print(
+            f"perf_regress: fleet wire bytes absent in r{old_n} or "
+            f"r{new_n} — skipping the wire gate"
+        )
     return bad
 
 

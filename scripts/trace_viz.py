@@ -17,13 +17,16 @@ Usage::
     python scripts/trace_viz.py /tmp/obs --out trace.json
     python scripts/trace_viz.py /tmp/obs --ceremony bac988c776b7  # one run
 
-Arguments may be JSONL files, directories (every ``*.jsonl`` inside is
-merged), or a mix.  See docs/observability.md for the event schema.
+Arguments may be JSONL files (optionally ``.jsonl.gz``), directories
+(every ``*.jsonl``/``*.jsonl.gz`` inside is merged), or shell-style glob
+patterns (quoted, so chaos/fleet runs with dozens of sinks are one
+command).  See docs/observability.md for the event schema.
 """
 
 from __future__ import annotations
 
 import argparse
+import glob as globlib
 import json
 import os
 import pathlib
@@ -35,13 +38,26 @@ from dkg_tpu.utils import obslog  # noqa: E402
 
 
 def collect_paths(args: list[str]) -> list[pathlib.Path]:
+    """Expand files, directories, and glob patterns into log paths."""
     out: list[pathlib.Path] = []
-    for a in args:
-        p = pathlib.Path(a)
+
+    def add(p: pathlib.Path) -> None:
         if p.is_dir():
             out.extend(sorted(p.glob("*.jsonl")))
+            out.extend(sorted(p.glob("*.jsonl.gz")))
         else:
             out.append(p)
+
+    for a in args:
+        p = pathlib.Path(a)
+        if p.exists():
+            add(p)
+            continue
+        matches = [pathlib.Path(m) for m in sorted(globlib.glob(a))]
+        for m in matches:
+            add(m)
+        if not matches:
+            out.append(p)  # reported as unreadable downstream
     return out
 
 
@@ -85,12 +101,13 @@ def main(argv: list[str] | None = None) -> int:
     spans = sum(1 for ev in events if ev.get("kind") == "span")
     compiles = sum(1 for ev in events if ev.get("kind") == "jax_compile")
     counters = sum(1 for ev in events if ev.get("kind") == "counter_sample")
+    flows = sum(1 for te in trace["traceEvents"] if te.get("ph") == "s")
     print(
         f"trace_viz: {len(events)} events from {len(paths)} log(s) -> "
         f"{len(trace['traceEvents'])} trace events ({len(ceremonies)} "
         f"ceremonies, {len(parties)} party timelines, {spans} spans, "
-        f"{compiles} jax compiles, {counters} counter samples) "
-        f"-> {args.out}"
+        f"{flows} publish->fetch flows, {compiles} jax compiles, "
+        f"{counters} counter samples) -> {args.out}"
     )
     return 0
 

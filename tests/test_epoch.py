@@ -461,12 +461,25 @@ def _run_epoch_sequence(n, t, seed, plan, churn, tmp_path, timeout=600.0):
 
 
 @pytest.mark.slow
-def test_manager_refresh_and_reshare_clean_run(tmp_path):
+def test_manager_refresh_and_reshare_clean_run(tmp_path, monkeypatch):
     """Fault-free n=4 sequence: one refresh + one 1-leave/1-join
-    reshare.  Every master observed in every epoch is the ceremony's."""
+    reshare.  Every master observed in every epoch is the ceremony's,
+    and the recorded epoch event stream conforms to the pinned obslog
+    schema (epoch_publish/epoch_tail mirror the ceremony kinds)."""
+    from dkg_tpu.utils import obslog
+
+    obsdir = tmp_path / "obs"
+    obsdir.mkdir()
+    monkeypatch.setenv("DKG_TPU_OBSLOG", str(obsdir))
     n, t, seed = 4, 1, 0xA11CE
     churn = ChurnSchedule(leavers=(2,), joiners=1)
     env, outs = _run_epoch_sequence(n, t, seed, FaultPlan(seed), churn, tmp_path)
+    events = [
+        ev for p in sorted(obsdir.glob("*.jsonl")) for ev in obslog.load_jsonl(p)
+    ]
+    kinds = {ev["kind"] for ev in events}
+    assert {"epoch_head", "epoch_publish", "epoch_tail", "epoch_done"} <= kinds
+    assert obslog.validate_events(events) == []
     founding, joiners = outs[:n], outs[n:]
     assert all(o.error is None for o in outs), [o.error for o in outs]
     masters = {m for o in outs for m in o.masters}

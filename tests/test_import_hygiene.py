@@ -97,6 +97,55 @@ def test_lint_dkg005_bans_raw_writes_in_net():
     assert "DKG005" not in codes, codes
 
 
+def test_lint_dkg012_bans_raw_socket_io_in_net():
+    """DKG012: every socket send/receive in dkg_tpu/net/ flows through
+    the counted wire helpers so net_wire_bytes_total stays exact —
+    raw .sendall/.send/.recv/.recv_into elsewhere is flagged; the
+    helpers themselves and checkpoint.py (file IO) are exempt."""
+    import ast
+    import pathlib
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "scripts"))
+    try:
+        import lint_lite
+    finally:
+        sys.path.pop(0)
+
+    src = (
+        "def leak(sock, buf):\n"
+        "    sock.sendall(b'x')\n"
+        "    sock.send(b'x')\n"
+        "    sock.recv(4)\n"
+        "    sock.recv_into(buf)\n"
+        "def _wire_send(sock, data):\n"
+        "    sock.sendall(data)\n"  # the counted helper itself: sanctioned
+    )
+    tree = ast.parse(src)
+    codes = [
+        c
+        for _, c, _ in lint_lite._Checker(
+            pathlib.Path("dkg_tpu/net/evil.py"), tree, src
+        ).finish()
+    ]
+    assert codes.count("DKG012") == 4, codes
+    # net-scoped: the same source outside dkg_tpu/net/ is clean
+    codes = [
+        c
+        for _, c, _ in lint_lite._Checker(
+            pathlib.Path("dkg_tpu/utils/elsewhere.py"), tree, src
+        ).finish()
+    ]
+    assert "DKG012" not in codes, codes
+    # checkpoint.py is out of scope (WAL, fd-level file IO)
+    codes = [
+        c
+        for _, c, _ in lint_lite._Checker(
+            pathlib.Path("dkg_tpu/net/checkpoint.py"), tree, src
+        ).finish()
+    ]
+    assert "DKG012" not in codes, codes
+
+
 def test_lint_dkg007_bans_raw_config_and_spawns_in_service():
     """DKG007: service code reads knobs only through utils.envknobs
     (no raw ``os.environ`` / ``os.getenv``) and spawns execution

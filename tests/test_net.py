@@ -372,3 +372,37 @@ def test_tcp_hub_replies_error_byte_to_junk_frames():
         assert chan.fetch(1, 1, timeout=1.0) == {7: b"still alive"}
     finally:
         hub.stop()
+
+
+def test_wire_size_guard_is_typed_before_packing(monkeypatch):
+    """An oversized payload dies as PayloadTooLarge (carrying its size)
+    BEFORE the u32 length prefix is packed, on both guard paths: the
+    client publish, and the hub fetch reply for a payload that entered
+    through the backing channel without a client guard.  The hub thread
+    survives both."""
+    from dkg_tpu.net import channel as chmod
+
+    monkeypatch.setattr(chmod, "WIRE_MAX_PAYLOAD", 64)
+    hub = TcpHub().start()
+    try:
+        host, port = hub.address
+        chan = TcpHubChannel(
+            host, port, attempts=2, backoff_ms=1, io_timeout_s=1.0,
+            rng=random.Random(9),
+        )
+        with pytest.raises(chmod.PayloadTooLarge, match="65 bytes") as exc:
+            chan.publish(1, 1, b"x" * 65)
+        assert exc.value.size == 65 and exc.value.where == "client publish"
+        chan.publish(1, 1, b"y" * 64)  # exactly at the limit: fine
+        assert chan.fetch(1, expected=1, timeout=2.0) == {1: b"y" * 64}
+        # hub reply guard: the oversized payload bypassed the client
+        # guard entirely, so the hub must refuse to serialize it rather
+        # than tear the reply frame mid-stream
+        hub.channel.publish(2, 2, b"z" * 65)
+        with pytest.raises(TransportError):
+            chan.fetch(2, expected=1, timeout=2.0)
+        # and the hub still serves well-formed rounds afterwards
+        chan.publish(3, 1, b"ok")
+        assert chan.fetch(3, expected=1, timeout=2.0) == {1: b"ok"}
+    finally:
+        hub.stop()

@@ -254,9 +254,17 @@ def test_live_ceremony_logs_events_and_never_secret_bytes(monkeypatch, tmp_path)
         ev["kind"] == "fault_injected" and ev["fault"] == "restart" for ev in p3
     )
     assert any(ev["kind"] == "wal_resume" for ev in p3)
-    # and the whole run renders to a valid chrome trace
+    # every emitted event conforms to the pinned schema — an emit site
+    # cannot drift from EVENT_SCHEMA / docs/observability.md silently
+    assert obslog.validate_events(events) == []
+    # and the whole run renders to a valid chrome trace, with causal
+    # flow arrows linking publishes to the round_tails that fetched them
     doc = obslog.to_chrome_trace(events)
     assert any(e["ph"] == "X" for e in doc["traceEvents"])
+    starts = [e for e in doc["traceEvents"] if e.get("ph") == "s"]
+    finishes = [e for e in doc["traceEvents"] if e.get("ph") == "f"]
+    assert starts and len(starts) == len(finishes)
+    assert len({e["id"] for e in starts}) == len(starts)  # one flow per pair
     json.dumps(doc)
 
     # -- redaction: grep raw emitted bytes for every known secret -------

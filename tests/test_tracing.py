@@ -186,3 +186,19 @@ def test_ceremony_run_with_trace():
     # the fiat_shamir phase carries its digest/rho split + dispatch leg
     assert set(tr.subtimings_s["fiat_shamir"]) == {"digest", "rho"}
     assert tr.meta["digest_dispatch"] in ("device", "host")
+
+
+def test_wire_summary_totals_and_bytes_per_pair():
+    tr = CeremonyTrace()
+    assert tr.wire_summary() is None  # no wire counters bumped: absent
+    assert "wire" not in tr.as_dict()
+    tr.bump("net.wire_bytes_out", 686)
+    tr.bump("net.wire_bytes_out", 686)
+    tr.bump("net.wire_bytes_in", 2744)
+    w = tr.wire_summary()
+    assert w["wire_bytes_out"] == 1372
+    assert w["wire_bytes_in"] == 2744
+    assert "bytes_per_pair" not in w  # no committee size known
+    tr.meta["n"] = 4
+    w = tr.as_dict()["wire"]
+    assert w["bytes_per_pair"] == pytest.approx(1372 / 12)
