@@ -106,6 +106,37 @@ def width_cap(b: Bucket) -> int:
     return 1 if b.n >= WIDTH_CAP_N else WIDTHS[0]
 
 
+#: Message-count rungs for the sign lane (descending).  Only these
+#: batch shapes ever enter the ladder/MSM executables, so mixed sign
+#: traffic from any ceremony shares one warm program per (curve, rung).
+#: The ladder deliberately includes the small rungs (2, 1): existing
+#: callers with tiny batches keep their exact compiled shapes — a
+#: convoy of 2 runs as [2], not [1, 1] — and tail slices of big convoys
+#: reuse them instead of padding with phantom messages (a phantom
+#: message costs a full ladder lane; an extra warm narrow dispatch is
+#: microseconds).
+SIGN_RUNGS = (256, 64, 16, 4, 2, 1)
+
+
+def sign_rung_slices(total: int, batch_max: int = SIGN_RUNGS[0]) -> list[tuple[int, int]]:
+    """Greedy ``(start, stop)`` decomposition of ``total`` queued sign
+    messages into :data:`SIGN_RUNGS` shapes, each at most ``batch_max``
+    (total=21 -> [(0, 16), (16, 20), (20, 21)]).  The sign-lane analogue
+    of :func:`split_widths`, over the message axis instead of the
+    ceremony axis."""
+    if total < 0:
+        raise ValueError(f"sign_rung_slices: total={total} < 0")
+    out: list[tuple[int, int]] = []
+    at = 0
+    for w in SIGN_RUNGS:
+        if w > batch_max:
+            continue
+        while total - at >= w:
+            out.append((at, at + w))
+            at += w
+    return out
+
+
 def split_widths(k: int, batch_max: int = WIDTHS[0]) -> list[int]:
     """Greedy decomposition of a convoy of ``k`` ceremonies into ladder
     widths, each at most ``batch_max`` (k=7 -> [4, 2, 1]).  Splitting

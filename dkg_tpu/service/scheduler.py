@@ -44,6 +44,25 @@ watchdog thread respawns workers killed by non-``Exception`` escapes
 and re-queues (once) the convoys they held.  Signing survives Byzantine
 partials via RLC blame + per-ceremony signer quarantine (:meth:`sign`).
 
+The sign lane (docs/signing.md "Steady-state lane"): a deployed DKG
+signs orders of magnitude more than it runs ceremonies, so signing gets
+its own queue and worker.  :meth:`sign` is submit+wait over the lane
+(:meth:`sign_submit` / :meth:`sign_wait`); queued requests from ANY
+ceremony coalesce into per-(curve, proved) *sign convoys*, flushed when
+``sign_batch_max`` messages are queued or the head request has waited
+``sign_flush_ms`` — so mixed tenants share one warm executable per
+(curve, message rung) instead of one cold pipeline per caller.
+Unproved traffic runs the folded-scalar fast path (one ladder dispatch
+per ``buckets.SIGN_RUNGS`` slice, hashing rung k+1 under rung k's
+dispatch shadow); proved traffic keeps the per-request grid loop —
+identical rng stream, blame, and quarantine semantics to the
+pre-lane path — against the warm caches in ``sign.cache.SignCache``
+(decoded shares and pk ladders per (ceremony, epoch) — the epoch CAS
+bump IS the invalidation — Lagrange coefficients per (curve, quorum)).
+Either leg produces signature bytes bit-identical to the pre-lane
+single-call path.  A request failing alone is ``PoisonedRequest``;
+convoy-mates are exonerated by bisection, exactly like ceremonies.
+
 Knobs (all validated through utils.envknobs; constructor arguments
 win): ``DKG_TPU_SERVICE_CONCURRENCY`` (workers, default 4),
 ``DKG_TPU_SERVICE_QUEUE_DEPTH`` (admission bound, default 256),
@@ -58,7 +77,9 @@ default 3 — see service.durable), ``DKG_TPU_SERVICE_HTTP_PORT``
 (observability scrape surface — service/httpobs; unset = off),
 ``DKG_TPU_RUNTIMEOBS`` (JAX compile/memory telemetry —
 utils/runtimeobs), ``DKG_TPU_SLO_*`` (rolling SLO objectives —
-service/slo).
+service/slo), ``DKG_TPU_SIGN_FLUSH_MS`` (sign-lane deadline flush,
+default 25), ``DKG_TPU_SIGN_BATCH_MAX`` (max messages per sign convoy,
+default ``buckets.SIGN_RUNGS[0]``).
 """
 
 from __future__ import annotations
@@ -106,6 +127,32 @@ class _Pending:
         self.crashes = 0  # worker-crash orphanings survived so far
 
 
+class _SignPending:
+    """One queued sign request: the lane's ticket.  ``done`` flips under
+    ``_sign_cond`` once ``sigs`` (success) or ``error`` (typed failure,
+    re-raised by :meth:`CeremonyScheduler.sign_wait`) is set."""
+
+    __slots__ = (
+        "cid", "curve", "msgs", "prove", "seed", "tamper", "enqueued_at",
+        "sigs", "error", "done", "rlc_passes", "resigns", "signers",
+    )
+
+    def __init__(self, cid, curve, msgs, prove, seed, tamper):
+        self.cid = cid
+        self.curve = curve
+        self.msgs = msgs
+        self.prove = prove
+        self.seed = seed
+        self.tamper = tamper
+        self.enqueued_at = time.monotonic()
+        self.sigs = None
+        self.error = None
+        self.done = False
+        self.rlc_passes = 0
+        self.resigns = 0
+        self.signers = 0
+
+
 class CeremonyScheduler:
     """Bounded-admission ceremony scheduler over one warm runtime.
 
@@ -124,6 +171,9 @@ class CeremonyScheduler:
         retries: int | None = None,
         retry_backoff_s: float | None = None,
         max_replays: int | None = None,
+        sign_flush_ms: float | None = None,
+        sign_batch_max: int | None = None,
+        sign_cache=None,
         watchdog_interval_s: float = 0.5,
         fault_plan=None,
         log=None,
@@ -169,9 +219,24 @@ class CeremonyScheduler:
                 "DKG_TPU_SERVICE_MAX_REPLAYS",
                 "journal replays before a pending ceremony is poisoned",
             ) or 3
+        if sign_flush_ms is None:
+            sign_flush_ms = envknobs.nonneg_float(
+                "DKG_TPU_SIGN_FLUSH_MS",
+                "sign-lane deadline flush in milliseconds (0 = immediate)",
+            )
+            sign_flush_ms = 25.0 if sign_flush_ms is None else sign_flush_ms
+        if sign_batch_max is None:
+            sign_batch_max = envknobs.pos_int(
+                "DKG_TPU_SIGN_BATCH_MAX", "max messages per sign convoy"
+            ) or buckets.SIGN_RUNGS[0]
+        from ..sign.cache import SignCache  # lazy like the sign() leg
+
         self.concurrency = concurrency
         self.queue_depth = queue_depth
         self.batch_max = min(batch_max, buckets.WIDTHS[0])
+        self.sign_flush_s = sign_flush_ms / 1000.0
+        self.sign_batch_max = sign_batch_max
+        self.sign_cache = sign_cache if sign_cache is not None else SignCache()
         self.default_deadline_s = deadline_s
         self.retries = retries
         self.retry_backoff_s = retry_backoff_s
@@ -191,6 +256,16 @@ class CeremonyScheduler:
         self._gen = 0  # respawn generation, for unique thread names
         self._running = True
         self._draining = False
+        # sign lane state: its OWN condition so coalescing/waking sign
+        # traffic never contends with ceremony admission.  Lock order:
+        # _cond may be taken while holding nothing; _sign_cond likewise;
+        # _cond -> _sign_cond is allowed (watchdog), _sign_cond -> _cond
+        # is FORBIDDEN — lane code snapshots under _cond first, releases,
+        # then takes _sign_cond to deliver.
+        self._sign_cond = threading.Condition()
+        self._sign_queue: list[_SignPending] = []
+        self._sign_inflight: list[_SignPending] = []
+        self._sign_gen = 0
         self._watchdog_interval_s = watchdog_interval_s
         self._journal = ServiceJournal(wal_dir) if wal_dir else None
         if self._journal is not None:
@@ -206,6 +281,10 @@ class CeremonyScheduler:
         ]
         for w in self._workers:
             w.start()
+        self._sign_thread = threading.Thread(
+            target=self._sign_worker, name="dkg-svc-sign", daemon=True
+        )
+        self._sign_thread.start()
         self._watchdog = threading.Thread(
             target=self._watchdog_loop, name="dkg-svc-watchdog", daemon=True
         )
@@ -242,6 +321,15 @@ class CeremonyScheduler:
             if drain:
                 while self._queue:
                     self._cond.wait(timeout=0.1)
+        # drain the sign lane BEFORE flipping _running: the lane flushes
+        # immediately once _draining is up, and queued tickets complete
+        # normally (drain) instead of failing
+        with self._sign_cond:
+            self._sign_cond.notify_all()
+            if drain:
+                while self._sign_queue or self._sign_inflight:
+                    self._sign_cond.wait(timeout=0.1)
+        with self._cond:
             self._running = False
             dropped = list(self._queue)
             self._queue.clear()
@@ -259,9 +347,17 @@ class CeremonyScheduler:
                     ),
                 )
             self._cond.notify_all()
+        with self._sign_cond:
+            for p in self._sign_queue:
+                if not p.done:
+                    p.error = QueueFullError("scheduler is shutting down")
+                    p.done = True
+            self._sign_queue.clear()
+            self._sign_cond.notify_all()
         for w in self._workers:
             w.join(timeout=60)
         self._watchdog.join(timeout=60)
+        self._sign_thread.join(timeout=60)
         if self._http is not None:
             self._http.close()
         if self._own_log and self._log is not None:
@@ -574,110 +670,430 @@ class CeremonyScheduler:
         verification; tests and scripts/service_storm.py use it to play
         the Byzantine signer.
 
-        Like refresh/reshare this runs on the caller's thread against a
-        snapshot of the held shares; it never mutates the outcome, so
-        concurrent epoch ops are safe (and by share-refresh algebra the
-        signatures they produce are identical).
+        Since the steady-state lane landed this is submit+wait over the
+        sign queue (:meth:`sign_submit` / :meth:`sign_wait`): the
+        request may coalesce with other callers' into one warm convoy,
+        but the bytes, the rng-derived quorum rotation, the blame /
+        quarantine behaviour, and every raised type are identical to
+        running alone.  It never mutates the outcome, so concurrent
+        epoch ops are safe (and by share-refresh algebra the signatures
+        they produce are identical).
         """
-        from .. import sign as signing
-        from ..sign import verify as sign_verify
-
         if not msgs:
             return []
-        t0 = time.monotonic()
-        ts0 = time.time()
+        return self.sign_wait(
+            self.sign_submit(cid, msgs, prove=prove, seed=seed, tamper=tamper)
+        )
+
+    def sign_submit(
+        self,
+        cid: str,
+        msgs: list[bytes],
+        *,
+        prove: bool = True,
+        seed: int | None = None,
+        tamper=None,
+    ) -> _SignPending:
+        """Enqueue a sign request on the lane and return its ticket
+        (pass to :meth:`sign_wait`).  Raises here, on the caller's
+        thread, for the same preconditions the synchronous path raised
+        for: KeyError (unknown ceremony), ValueError (not done /
+        share-less), :class:`QueueFullError` (shutting down)."""
         with self._cond:
             out = self._held_outcome(cid)
-            fs = gh.ALL_GROUPS[out.curve].scalar_field
-            shares = [int(v) for v in fh.decode(fs, out.final_shares)]
-            qualified = out.qualified
-            curve, t = out.curve, out.t
-            quarantined = set(self._quarantine.get(cid, ()))
-        eligible = [
+            curve = out.curve
+        p = _SignPending(cid, curve, list(msgs), prove, seed, tamper)
+        with self._sign_cond:
+            if not self._running or self._draining:
+                raise QueueFullError("scheduler is shutting down")
+            self._sign_queue.append(p)
+            self.metrics.set_gauge(
+                "sign_queue_depth",
+                sum(len(q.msgs) for q in self._sign_queue),
+            )
+            self._sign_cond.notify_all()
+        return p
+
+    def sign_wait(
+        self, ticket: _SignPending, timeout: float | None = None
+    ) -> list[bytes]:
+        """Block until the lane finishes ``ticket``; returns the
+        signature bytes or re-raises the request's typed failure
+        (TimeoutError on timeout, with the request still in flight)."""
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        with self._sign_cond:
+            while not ticket.done:
+                remain = None
+                if deadline is not None:
+                    remain = deadline - time.monotonic()
+                    if remain <= 0:
+                        raise TimeoutError(
+                            f"sign request for {ticket.cid} still in the lane"
+                        )
+                self._sign_cond.wait(timeout=remain)
+        if ticket.error is not None:
+            raise ticket.error
+        return ticket.sigs
+
+    # -- sign lane (worker side) ---------------------------------------------
+
+    def _pop_sign_convoy(self):
+        """Wait for a flush condition and pop one sign convoy: the head
+        ticket plus queued mates sharing its (curve, proved) key, capped
+        at ``sign_batch_max`` total messages (a lone over-wide ticket
+        still pops alone — rung slicing inside the leg bounds the device
+        shapes).  Flush fires when the cap is reached (``full``), when
+        the head has waited ``sign_flush_ms`` (``deadline``), or
+        immediately on drain/shutdown.  Returns (convoy, reason), or
+        None when shut down and empty."""
+        with self._sign_cond:
+            while True:
+                if not self._running:
+                    if not self._sign_queue:
+                        return None
+                elif not self._sign_queue:
+                    self._sign_cond.wait(timeout=0.2)
+                    continue
+                head = self._sign_queue[0]
+                key = (head.curve, head.prove)
+                mates = [
+                    p
+                    for p in self._sign_queue
+                    if (p.curve, p.prove) == key
+                ]
+                total = sum(len(p.msgs) for p in mates)
+                age = time.monotonic() - head.enqueued_at
+                if (
+                    self._running
+                    and not self._draining
+                    and total < self.sign_batch_max
+                    and age < self.sign_flush_s
+                ):
+                    # more traffic may coalesce: sleep to the deadline
+                    self._sign_cond.wait(timeout=self.sign_flush_s - age)
+                    continue
+                reason = "full" if total >= self.sign_batch_max else "deadline"
+                convoy: list[_SignPending] = []
+                taken = 0
+                for p in mates:
+                    if convoy and taken + len(p.msgs) > self.sign_batch_max:
+                        break
+                    convoy.append(p)
+                    taken += len(p.msgs)
+                for p in convoy:
+                    self._sign_queue.remove(p)
+                self._sign_inflight = list(convoy)
+                self.metrics.inc("sign_flush_total", reason=reason)
+                self.metrics.set_gauge(
+                    "sign_queue_depth",
+                    sum(len(q.msgs) for q in self._sign_queue),
+                )
+                return convoy, reason
+
+    def _sign_worker(self) -> None:
+        while True:
+            popped = self._pop_sign_convoy()
+            if popped is None:
+                return
+            convoy, reason = popped
+            self._run_sign_convoy(convoy, reason)
+
+    def _run_sign_convoy(self, convoy, reason) -> None:
+        t0 = time.monotonic()
+        ts0 = time.time()
+        subs = {
+            "hash_s": 0.0, "partial_s": 0.0,
+            "verify_s": 0.0, "aggregate_s": 0.0,
+        }
+        try:
+            self._sign_execute(convoy, subs)
+        except Exception as exc:  # noqa: BLE001 — the lane must survive
+            self._isolate_sign(convoy, exc, subs)
+        dt = time.monotonic() - t0
+        self.metrics.inc("sign_convoys_total")
+        if self._log is not None:
+            # the lane thread has no ambient obslog context
+            # (obslog.current() is a contextvar on the caller's thread)
+            # so the convoy span goes to the scheduler's own recorder
+            self._log.emit_span(
+                "sign_convoy",
+                ts0=ts0,
+                mono0=t0,
+                dur_s=dt,
+                subs=subs,
+                curve=convoy[0].curve,
+                requests=len(convoy),
+                messages=sum(len(p.msgs) for p in convoy),
+                ceremonies=len({p.cid for p in convoy}),
+                proved=convoy[0].prove,
+                reason=reason,
+                errors=sum(1 for p in convoy if p.error is not None),
+            )
+        self._deliver_sign(convoy)
+
+    def _deliver_sign(self, convoy) -> None:
+        """Per-ticket terminal accounting (success metrics mirror the
+        pre-lane synchronous path, ceremony-labelled) and waiter wakeup."""
+        now = time.monotonic()
+        for p in convoy:
+            if p.error is None and p.sigs is None:
+                # every path below should have concluded the ticket;
+                # a fake/monkeypatched engine that forgot one must not
+                # strand its waiter forever
+                p.error = errors.TransientEngineError(
+                    "SIGN_LANE_LOST: convoy concluded without a result"
+                )
+            if p.error is None:
+                self.metrics.inc("sign_requests_total", ceremony=p.cid)
+                self.metrics.inc(
+                    "sign_messages_total", len(p.msgs), ceremony=p.cid
+                )
+                if p.rlc_passes:
+                    self.metrics.inc(
+                        "sign_rlc_passes_total", p.rlc_passes, ceremony=p.cid
+                    )
+                self.metrics.observe(
+                    "sign_seconds", now - p.enqueued_at, ceremony=p.cid
+                )
+        with self._sign_cond:
+            for p in convoy:
+                p.done = True
+            self._sign_inflight = []
+            self._sign_cond.notify_all()
+
+    def _sign_execute(self, convoy, subs) -> None:
+        """Compute every still-live ticket in ``convoy``: the lane's
+        engine surface (tests fake it the way engine tests fake
+        start/finish_convoy).  Unproved, untampered tickets take the
+        folded fast leg together; proved (or tampered) tickets run the
+        per-request grid loop — same rng stream as the pre-lane path, so
+        bytes/blame/metrics are identical.  Per-ticket failures land on
+        the ticket; only convoy-shared failures raise (caller bisects).
+        """
+        fast, grid = [], []
+        for p in convoy:
+            if p.error is not None or p.sigs is not None:
+                continue
+            snap = self._sign_snapshot(p)
+            if snap is None:
+                continue  # precondition failure already on the ticket
+            if p.prove or p.tamper is not None:
+                grid.append((p, snap))
+            else:
+                fast.append((p, snap))
+        self._sign_fast_leg(fast, subs)
+        for p, snap in grid:
+            try:
+                p.sigs = self._sign_grid_one(p, snap, subs)
+            except errors.ServiceError as exc:
+                p.error = exc  # typed (InsufficientSigners...): solo parity
+            except Exception as exc:  # noqa: BLE001 — lane must conclude
+                self._poison_sign_one(p, exc)
+
+    def _sign_snapshot(self, p):
+        """(CeremonyMaterial, t, qualified) for a ticket — the held
+        outcome is snapshotted under ``_cond`` but decoded OUTSIDE it,
+        behind the per-(ceremony, epoch) cache: a slow sign no longer
+        stalls admission or epoch ops.  Records precondition failures
+        (unknown / not-done / retired ceremony) on the ticket."""
+        try:
+            with self._cond:
+                out = self._held_outcome(p.cid)
+                curve, t, qualified = out.curve, out.t, out.qualified
+                epoch, final_shares = out.epoch, out.final_shares
+        except (KeyError, ValueError) as exc:
+            p.error = exc
+            return None
+        mat = self.sign_cache.ceremony(p.cid, epoch, curve, final_shares)
+        return mat, t, qualified
+
+    def _sign_eligible(self, p, qualified) -> list[int]:
+        with self._cond:
+            quarantined = set(self._quarantine.get(p.cid, ()))
+        return [
             i + 1
             for i, q in enumerate(qualified)
             if q and (i + 1) not in quarantined
         ]
-        h_points, _ = signing.hash_to_curve_batch(curve, list(msgs))
-        t_hash = time.monotonic()
-        rng = random.Random(seed) if seed is not None else random.SystemRandom()
+
+    def _sign_starved(self, p, eligible, need) -> errors.InsufficientSigners:
+        self.metrics.inc("sign_starved_total", ceremony=p.cid)
+        self._emit(
+            "sign_starved", ceremony=p.cid,
+            eligible=len(eligible), need=need,
+        )
+        return errors.InsufficientSigners(
+            f"ceremony {p.cid} has {len(eligible)} eligible "
+            f"qualified signers, needs t+1={need}"
+        )
+
+    def _sign_fast_leg(self, fast, subs) -> None:
+        """The steady-state throughput path: every unproved ticket's
+        messages, from ANY ceremony, signed by ONE folded ladder per
+        ``buckets.SIGN_RUNGS`` slice.  sigma = f(0) per ceremony comes
+        from the cache, so per-ticket work is a quorum draw and a row of
+        precomputed limbs; hashing of rung k+1 runs under rung k's
+        dispatch shadow, and nothing blocks until every rung is in
+        flight (``sign.folded_collect``)."""
+        from .. import sign as signing
+
+        live = []
+        for p, (mat, t, qualified) in fast:
+            eligible = self._sign_eligible(p, qualified)
+            if len(eligible) < t + 1:
+                p.error = self._sign_starved(p, eligible, t + 1)
+                continue
+            # seed-derived quorum rotation, as in the grid leg — the
+            # fold makes the draw byte-irrelevant (sigma == f(0) for
+            # every honest quorum) but keeps rotation observability
+            rng = (
+                random.Random(p.seed)
+                if p.seed is not None
+                else random.SystemRandom()
+            )
+            quorum = sorted(rng.sample(eligible, t + 1))
+            p.signers = len(quorum)
+            live.append((p, self.sign_cache.fold_limbs(mat, quorum)))
+        if not live:
+            return
+        curve = live[0][0].curve
+        msgs: list[bytes] = []
+        rows = []
+        for p, sigma in live:
+            msgs.extend(p.msgs)
+            rows.extend([sigma] * len(p.msgs))
+        rows = np.asarray(rows)  # (B, L)
+        pending = []
+        t_partial = 0.0
+        for a, b in buckets.sign_rung_slices(len(msgs), self.sign_batch_max):
+            th0 = time.monotonic()
+            _, h_dev = signing.hash_to_curve_batch(curve, msgs[a:b])
+            tp0 = time.monotonic()
+            subs["hash_s"] += tp0 - th0
+            pending.append(signing.sign_folded(curve, rows[a:b], h_dev))
+            t_partial += time.monotonic() - tp0
+        ta0 = time.monotonic()
+        wire = signing.signature_encode(
+            curve, signing.folded_collect(curve, pending)
+        )
+        subs["partial_s"] += t_partial
+        subs["aggregate_s"] += time.monotonic() - ta0
+        at = 0
+        for p, _sigma in live:
+            p.sigs = wire[at : at + len(p.msgs)]
+            at += len(p.msgs)
+
+    def _sign_grid_one(self, p, snap, subs) -> list[bytes]:
+        """The pre-lane per-request loop, verbatim semantics, minus the
+        re-derivation: shares/pks come from the (ceremony, epoch) cache,
+        Lagrange coefficients from the (curve, quorum) cache.  rng
+        consumption order (quorum draw -> DLEQ nonces -> RLC challenges)
+        matches the old synchronous path exactly, so a seeded request
+        produces the same bytes, blame, and pass counts it always did."""
+        from .. import sign as signing
+        from ..sign import verify as sign_verify
+
+        mat, t, qualified = snap
+        curve = mat.curve
+        eligible = self._sign_eligible(p, qualified)
+        th0 = time.monotonic()
+        h_points, _ = signing.hash_to_curve_batch(curve, list(p.msgs))
+        subs["hash_s"] += time.monotonic() - th0
+        rng = (
+            random.Random(p.seed)
+            if p.seed is not None
+            else random.SystemRandom()
+        )
         passes = 0
-        resigns = 0
         while True:
             if len(eligible) < t + 1:
-                self.metrics.inc("sign_starved_total", ceremony=cid)
-                self._emit(
-                    "sign_starved", ceremony=cid,
-                    eligible=len(eligible), need=t + 1,
-                )
-                raise errors.InsufficientSigners(
-                    f"ceremony {cid} has {len(eligible)} eligible "
-                    f"qualified signers, needs t+1={t + 1}"
-                )
+                raise self._sign_starved(p, eligible, t + 1)
             # seed-derived quorum rotation: never always-first-t+1, so
             # load (and exposure) spreads across the qualified set
             quorum = sorted(rng.sample(eligible, t + 1))
+            tp0 = time.monotonic()
             ps = signing.partial_sign(
                 curve,
-                [shares[i - 1] for i in quorum],
+                [mat.shares[i - 1] for i in quorum],
                 quorum,
                 h_points,
                 rng=rng,
-                prove=prove,
+                prove=p.prove,
+                pks=self.sign_cache.quorum_pks(mat, quorum),
             )
-            if tamper is not None:
-                ps = tamper(ps) or ps
-            if not prove:
+            subs["partial_s"] += time.monotonic() - tp0
+            if p.tamper is not None:
+                ps = p.tamper(ps) or ps
+            if not p.prove:
                 break
+            tv0 = time.monotonic()
             report = sign_verify.rlc_verify(ps, rng=rng)
+            subs["verify_s"] += time.monotonic() - tv0
             passes += report.passes
             if report.ok:
                 break
             blamed = sorted({quorum[si] for (_bi, si) in report.bad_cells})
-            resigns += 1
+            p.resigns += 1
             with self._cond:
-                self._quarantine.setdefault(cid, set()).update(blamed)
+                self._quarantine.setdefault(p.cid, set()).update(blamed)
             self.metrics.inc(
-                "sign_quarantined_total", len(blamed), ceremony=cid
+                "sign_quarantined_total", len(blamed), ceremony=p.cid
             )
-            self.metrics.inc("sign_resigns_total", ceremony=cid)
+            self.metrics.inc("sign_resigns_total", ceremony=p.cid)
             self._emit(
                 "sign_blame",
-                ceremony=cid,
+                ceremony=p.cid,
                 blamed=blamed,
                 cells=[list(c) for c in report.bad_cells],
                 passes=report.passes,
             )
             eligible = [i for i in eligible if i not in blamed]
-        t_partial = time.monotonic()
-        sigs = signing.signature_encode(curve, signing.aggregate(ps))
-        dt = time.monotonic() - t0
-        self.metrics.inc("sign_requests_total", ceremony=cid)
-        self.metrics.inc("sign_messages_total", len(msgs), ceremony=cid)
-        if passes:
-            self.metrics.inc("sign_rlc_passes_total", passes, ceremony=cid)
-        self.metrics.observe("sign_seconds", dt, ceremony=cid)
-        log = obslog.current()
-        if log is not None:
-            log.emit_span(
-                "sign",
-                ts0=ts0,
-                mono0=t0,
-                dur_s=dt,
-                subs={
-                    "hash_s": t_hash - t0,
-                    "partial_s": t_partial - t_hash,
-                    "aggregate_s": time.monotonic() - t_partial,
-                },
-                ceremony=cid,
-                curve=curve,
-                messages=len(msgs),
-                signers=len(quorum),
-                proved=prove,
-                rlc_passes=passes,
-                resigns=resigns,
-            )
+        ta0 = time.monotonic()
+        lam = self.sign_cache.lagrange_at_zero(curve, tuple(quorum))[1]
+        sigs = signing.signature_encode(
+            curve, signing.aggregate(ps, lam=lam)
+        )
+        subs["aggregate_s"] += time.monotonic() - ta0
+        p.rlc_passes = passes
+        p.signers = len(quorum)
         return sigs
+
+    def _poison_sign_one(self, p, exc) -> None:
+        """Width-1 sign failure: the ticket is the culprit.  Typed
+        ServiceErrors pass through (callers branch on them); anything
+        else surfaces as :class:`PoisonedRequest`."""
+        self.metrics.inc("sign_poisoned_total", ceremony=p.cid)
+        self._emit(
+            "sign_poisoned", ceremony=p.cid, error_kind=type(exc).__name__
+        )
+        if isinstance(exc, errors.ServiceError):
+            p.error = exc
+        else:
+            p.error = errors.PoisonedRequest(f"{type(exc).__name__}: {exc}")
+
+    def _isolate_sign(self, convoy, exc, subs) -> None:
+        """A sign (sub-)convoy raised outside any single ticket's own
+        guarded leg: bisect, exactly like ceremony convoys — healthy
+        halves re-run and complete bit-identically to signing alone,
+        and the ticket still failing by itself is poisoned."""
+        live = [p for p in convoy if p.error is None and p.sigs is None]
+        if not live:
+            return
+        if len(live) == 1:
+            self._poison_sign_one(live[0], exc)
+            return
+        self.metrics.inc("sign_bisections_total")
+        self._emit(
+            "sign_convoy_bisect",
+            width=len(live),
+            error_kind=type(exc).__name__,
+        )
+        mid = len(live) // 2
+        for half in (live[:mid], live[mid:]):
+            try:
+                self._sign_execute(half, subs)
+            except Exception as e2:  # noqa: BLE001 — isolation must conclude
+                self._isolate_sign(half, e2, subs)
 
     # -- worker side --------------------------------------------------------
 
@@ -803,56 +1219,94 @@ class CeremonyScheduler:
                 self._cond.wait(timeout=self._watchdog_interval_s)
                 if not self._running:
                     return
-                for i, w in enumerate(self._workers):
-                    if w.is_alive():
-                        continue
-                    orphans = self._held.pop(i, [])
-                    self._gen += 1
-                    nw = threading.Thread(
-                        target=self._worker,
-                        args=(i,),
-                        name=f"dkg-svc-{i}r{self._gen}",
-                        daemon=True,
+                self._watch_pool()
+            # outside the _cond block: the sign check takes _sign_cond,
+            # and holding _cond across it is legal (_cond -> _sign_cond
+            # order) but pointless contention
+            self._maybe_respawn_sign_worker()
+
+    def _watch_pool(self) -> None:
+        """One watchdog sweep over the ceremony worker pool (caller
+        holds ``_cond``)."""
+        for i, w in enumerate(self._workers):
+            if w.is_alive():
+                continue
+            orphans = self._held.pop(i, [])
+            self._gen += 1
+            nw = threading.Thread(
+                target=self._worker,
+                args=(i,),
+                name=f"dkg-svc-{i}r{self._gen}",
+                daemon=True,
+            )
+            self._workers[i] = nw
+            nw.start()
+            self.metrics.inc("service_worker_restarts_total")
+            self._emit("service_worker_restart", slot=i)
+            for convoy in orphans:
+                for p in convoy:
+                    p.crashes += 1
+                    if p.crashes > _MAX_CRASH_REQUEUES:
+                        self._emit(
+                            "service_worker_crash_failed",
+                            ceremony=p.cid,
+                        )
+                        self.metrics.inc(
+                            "service_failed_total",
+                            kind="WORKER_CRASH",
+                        )
+                        self._finish_one(
+                            CeremonyOutcome(
+                                ceremony_id=p.cid,
+                                status="failed",
+                                curve=p.req.curve,
+                                n=p.req.n,
+                                t=p.req.t,
+                                error=(
+                                    "WORKER_CRASH: worker died "
+                                    f"{p.crashes}x holding this "
+                                    "request"
+                                ),
+                            ),
+                            durable=p.req.durable,
+                        )
+                    else:
+                        self._queue.insert(0, p)
+                        self._status[p.cid] = "queued"
+                        self.metrics.inc("service_requeued_total")
+            self.metrics.set_gauge(
+                "service_queue_depth", len(self._queue)
+            )
+            self._cond.notify_all()
+
+    def _maybe_respawn_sign_worker(self) -> None:
+        """Watchdog leg for the sign lane: respawn a dead sign worker.
+        Tickets it held in flight fail as TransientEngineError — the
+        convoy may be what killed it, so re-running is the caller's
+        call, not the lane's."""
+        with self._sign_cond:
+            if not self._running or self._sign_thread.is_alive():
+                return
+            orphans = list(self._sign_inflight)
+            self._sign_inflight = []
+            self._sign_gen += 1
+            nt = threading.Thread(
+                target=self._sign_worker,
+                name=f"dkg-svc-sign-r{self._sign_gen}",
+                daemon=True,
+            )
+            self._sign_thread = nt
+            nt.start()
+            self.metrics.inc("service_worker_restarts_total")
+            self._emit("sign_worker_restart")
+            for p in orphans:
+                if not p.done:
+                    p.error = errors.TransientEngineError(
+                        "SIGN_WORKER_CRASH: sign worker died holding "
+                        "this request"
                     )
-                    self._workers[i] = nw
-                    nw.start()
-                    self.metrics.inc("service_worker_restarts_total")
-                    self._emit("service_worker_restart", slot=i)
-                    for convoy in orphans:
-                        for p in convoy:
-                            p.crashes += 1
-                            if p.crashes > _MAX_CRASH_REQUEUES:
-                                self._emit(
-                                    "service_worker_crash_failed",
-                                    ceremony=p.cid,
-                                )
-                                self.metrics.inc(
-                                    "service_failed_total",
-                                    kind="WORKER_CRASH",
-                                )
-                                self._finish_one(
-                                    CeremonyOutcome(
-                                        ceremony_id=p.cid,
-                                        status="failed",
-                                        curve=p.req.curve,
-                                        n=p.req.n,
-                                        t=p.req.t,
-                                        error=(
-                                            "WORKER_CRASH: worker died "
-                                            f"{p.crashes}x holding this "
-                                            "request"
-                                        ),
-                                    ),
-                                    durable=p.req.durable,
-                                )
-                            else:
-                                self._queue.insert(0, p)
-                                self._status[p.cid] = "queued"
-                                self.metrics.inc("service_requeued_total")
-                    self.metrics.set_gauge(
-                        "service_queue_depth", len(self._queue)
-                    )
-                    self._cond.notify_all()
+                    p.done = True
+            self._sign_cond.notify_all()
 
     def _finish(self, convoy, fl, t0) -> None:
         try:

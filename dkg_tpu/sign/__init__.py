@@ -18,35 +18,48 @@ threshold-signature service, pairing-free by construction:
   accept an all-honest grid in ONE combined check, locate Byzantine
   (message, signer) cells in O(log) further checks — the primitive
   behind the scheduler's signer quarantine.
+* :mod:`.cache` — the steady-state lane's warm-path caches: decoded
+  share vectors per (ceremony, epoch), Lagrange-at-zero coefficients
+  per (curve, quorum), per-quorum public keys, and the folded signing
+  scalar behind :func:`partial.sign_folded`'s one-ladder fast path.
 
-Service integration is ``service.scheduler.CeremonyScheduler.sign``.
+Service integration is ``service.scheduler.CeremonyScheduler.sign``
+(synchronous submit+wait over the scheduler's convoy-batched sign
+lane — see docs/signing.md "Steady-state lane").
 Knobs (utils.envknobs, explicit arguments win): ``DKG_TPU_SIGN_BATCH``
 (device message-chunk size), ``DKG_TPU_SIGN_DISPATCH`` (device|host),
 ``DKG_TPU_SIGN_RLC_DISPATCH`` (host|device RLC combine leg).
 """
 
 from .aggregate import aggregate, aggregate_host, signature_encode
+from .cache import CeremonyMaterial, SignCache
 from .hash2curve import hash_to_curve_batch, hash_to_curve_host
 from .partial import (
     PartialSignatures,
+    folded_collect,
     partial_sign,
     partial_sign_host,
     public_keys,
+    sign_folded,
     verify_partials,
 )
 from .verify import RlcReport, rlc_verify
 
 __all__ = [
+    "CeremonyMaterial",
     "PartialSignatures",
     "RlcReport",
+    "SignCache",
     "aggregate",
     "aggregate_host",
+    "folded_collect",
     "hash_to_curve_batch",
     "hash_to_curve_host",
     "partial_sign",
     "partial_sign_host",
     "public_keys",
     "rlc_verify",
+    "sign_folded",
     "signature_encode",
     "verify_partials",
 ]

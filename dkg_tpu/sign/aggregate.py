@@ -36,20 +36,32 @@ from ..poly import host as ph
 from .partial import PartialSignatures
 
 
-def aggregate(ps: PartialSignatures, subset: list[int] | None = None) -> np.ndarray:
+def aggregate(
+    ps: PartialSignatures,
+    subset: list[int] | None = None,
+    lam: np.ndarray | None = None,
+) -> np.ndarray:
     """Aggregate a t+1 subset of partials into full signatures.
 
     ``subset``: positions into ``ps.indices`` (default: all signers the
-    batch carries).  Returns ``(B, C, L)`` canonical affine limbs — the
-    same currency as the partials, ready for :func:`signature_encode`.
+    batch carries).  ``lam``: precomputed canonical ``(M, L)``
+    Lagrange-at-zero limbs for the subset's x's (the sign lane caches
+    them per (curve, quorum) — ``sign.cache.SignCache.lagrange_at_zero``
+    is limb-identical to the device derivation, parity pinned in
+    tests/test_sign.py); default derives them on device.  Returns
+    ``(B, C, L)`` canonical affine limbs — the same currency as the
+    partials, ready for :func:`signature_encode`.
     """
     cs = gd.ALL_CURVES[ps.curve]
     pos = list(range(len(ps.indices))) if subset is None else list(subset)
-    xs = [ps.indices[p] for p in pos]
     sigs = jnp.asarray(ps.sigs[:, pos])  # (B, M, C, L)
-    xs_limbs = jnp.asarray(fh.encode(cs.scalar, xs))  # (M, L)
-    lam = pd.lagrange_at_zero_coeffs(cs.scalar, xs_limbs)  # (M, L)
-    agg = gd.msm_pippenger(cs, lam, sigs)  # (B, C, L)
+    if lam is None:
+        xs = [ps.indices[p] for p in pos]
+        xs_limbs = jnp.asarray(fh.encode(cs.scalar, xs))  # (M, L)
+        lam_arr = pd.lagrange_at_zero_coeffs(cs.scalar, xs_limbs)  # (M, L)
+    else:
+        lam_arr = jnp.asarray(lam)
+    agg = gd.msm_pippenger(cs, lam_arr, sigs)  # (B, C, L)
     return gd.affine_canon_host(cs, np.asarray(agg))
 
 

@@ -1,0 +1,210 @@
+"""Warm-path signing caches: every quorum-stable derivation, done once.
+
+SIGN_r01 showed the steady-state lane dominated not by curve math but
+by re-derivation: every ``scheduler.sign()`` call decoded the whole
+share vector (under the scheduler lock!), rebuilt the quorum's public
+keys through the fixed-base tables, and recomputed the Lagrange-at-zero
+coefficients on device (~seconds of warm wall per call at n=64).  None
+of that depends on the *message* being signed — it depends only on the
+ceremony's share epoch and the quorum's x-coordinates — so a serving
+lane can derive it once and sign thousands of messages against it.
+
+This module is the ONE sanctioned owner of that material (lint rule
+DKG013 bans ``lagrange_*``/``public_keys`` calls in
+``dkg_tpu/service/``): the scheduler's sign lane asks :class:`SignCache`
+and never re-derives per request.
+
+Three caches, three invalidation rules:
+
+* **ceremony material** — the decoded share vector, keyed
+  ``(ceremony_id, epoch)``.  The epoch CAS token the scheduler already
+  bumps on refresh/reshare IS the invalidation: a bump changes the key,
+  and inserting a new epoch proactively drops the ceremony's stale
+  entries.  Decoding happens here, OUTSIDE the scheduler's condition
+  lock — a slow sign can no longer stall admission or epoch ops.
+* **Lagrange-at-zero coefficients** — keyed ``(curve, quorum x's)``.
+  Host big-int (a t+1-point interpolation is microseconds on host;
+  the batched device inversion is for ceremony-scale vectors), encoded
+  to the same canonical limbs ``poly.device.lagrange_at_zero_coeffs``
+  produces (parity pinned in tests/test_sign.py).
+* **the folded signing scalar** — sigma = sum_i lambda_i(0) * s_i
+  (mod q), keyed ``(ceremony_id, epoch)``.  By interpolation at zero
+  this equals f(0) for EVERY honest quorum, so the fast lane signs a
+  message with ONE ladder lane (``sign.partial.sign_folded``) instead
+  of a (t+1)-wide grid plus an MSM — the work reduction behind the
+  steady-state signatures/s floor (docs/signing.md).
+
+Per-quorum public keys (for the proved grid path's DLEQ transcripts)
+are cached inside each ceremony entry, keyed by the quorum tuple, at
+the quorum shape the solo path always used — no new compile shapes.
+
+Thread-safety: one lock around the maps; the heavy derivations run
+outside it only when they touch the device (pk tables), so a lane
+worker never blocks the scheduler and vice versa.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from ..fields import host as fh
+from ..groups import host as gh
+from ..poly import host as ph
+
+
+class CeremonyMaterial:
+    """Everything quorum-stable about one (ceremony, epoch): the decoded
+    share vector plus lazily-built per-quorum public keys and the folded
+    signing scalar."""
+
+    __slots__ = ("cid", "epoch", "curve", "shares", "_pks", "_fold", "_lock")
+
+    def __init__(self, cid: str, epoch: int, curve: str, shares: tuple[int, ...]):
+        self.cid = cid
+        self.epoch = epoch
+        self.curve = curve
+        self.shares = shares  # full n-vector, index i holds share at x=i+1
+        self._pks: OrderedDict[tuple[int, ...], tuple[np.ndarray, list]] = (
+            OrderedDict()
+        )
+        self._fold: np.ndarray | None = None  # (L,) canonical sigma limbs
+        self._lock = threading.Lock()
+
+
+class SignCache:
+    """LRU caches for the scheduler's sign lane (module docstring)."""
+
+    def __init__(
+        self,
+        capacity: int = 32,
+        lagrange_capacity: int = 256,
+        pk_capacity: int = 64,
+    ) -> None:
+        self.capacity = capacity
+        self.lagrange_capacity = lagrange_capacity
+        self.pk_capacity = pk_capacity
+        self._lock = threading.Lock()
+        self._ceremonies: OrderedDict[tuple[str, int], CeremonyMaterial] = (
+            OrderedDict()
+        )
+        # (curve, xs) -> (lambda ints tuple, (M, L) canonical limbs)
+        self._lagrange: OrderedDict[tuple, tuple[tuple[int, ...], np.ndarray]] = (
+            OrderedDict()
+        )
+        self.hits = 0
+        self.misses = 0
+
+    # -- ceremony material ---------------------------------------------------
+
+    def ceremony(
+        self, cid: str, epoch: int, curve: str, final_shares
+    ) -> CeremonyMaterial:
+        """The decoded material for ``(cid, epoch)``.  ``final_shares``
+        is the encoded limb array snapshotted from the held outcome
+        (refresh replaces, never mutates, that array — holding the
+        reference across the lock boundary is safe).  An epoch bump
+        changes the key; inserting the new epoch drops the ceremony's
+        stale entries."""
+        key = (cid, epoch)
+        with self._lock:
+            mat = self._ceremonies.get(key)
+            if mat is not None:
+                self._ceremonies.move_to_end(key)
+                self.hits += 1
+                return mat
+            self.misses += 1
+        # decode OUTSIDE both this cache's lock and (crucially) the
+        # scheduler's condition lock — the satellite bugfix: rebuilding
+        # n Python ints per sign call under self._cond stalled
+        # admission and epoch ops for the whole decode
+        fs = gh.ALL_GROUPS[curve].scalar_field
+        shares = tuple(int(v) for v in fh.decode(fs, final_shares))
+        mat = CeremonyMaterial(cid, epoch, curve, shares)
+        with self._lock:
+            won = self._ceremonies.setdefault(key, mat)
+            if won is mat:
+                for k in [
+                    k for k in self._ceremonies if k[0] == cid and k != key
+                ]:
+                    del self._ceremonies[k]  # stale epochs of this ceremony
+                while len(self._ceremonies) > self.capacity:
+                    self._ceremonies.popitem(last=False)
+            return won
+
+    # -- Lagrange-at-zero ----------------------------------------------------
+
+    def lagrange_at_zero(
+        self, curve: str, xs: tuple[int, ...]
+    ) -> tuple[tuple[int, ...], np.ndarray]:
+        """(lambda ints, canonical (M, L) limbs) for interpolation at
+        zero over nodes ``xs`` — host big-int, cached per (curve, xs),
+        limb-identical to the device leg (parity test in test_sign)."""
+        key = (curve, xs)
+        with self._lock:
+            hit = self._lagrange.get(key)
+            if hit is not None:
+                self._lagrange.move_to_end(key)
+                self.hits += 1
+                return hit
+            self.misses += 1
+        fs = gh.ALL_GROUPS[curve].scalar_field
+        nodes = [x % fs.modulus for x in xs]
+        lams = tuple(
+            ph.lagrange_coefficient(fs, 0, i, nodes) for i in range(len(nodes))
+        )
+        limbs = np.asarray(fh.encode(fs, list(lams)))
+        entry = (lams, limbs)
+        with self._lock:
+            self._lagrange[key] = entry
+            while len(self._lagrange) > self.lagrange_capacity:
+                self._lagrange.popitem(last=False)
+        return entry
+
+    # -- the folded signing scalar -------------------------------------------
+
+    def fold_limbs(self, mat: CeremonyMaterial, quorum: list[int]) -> np.ndarray:
+        """Canonical limbs of sigma = sum lambda_i(0) * s_i over
+        ``quorum`` (1-based indices into the ceremony's share vector).
+        Cached once per (ceremony, epoch): by Lagrange-at-zero algebra
+        sigma == f(0) for every honest quorum, so the first quorum's
+        fold serves all later ones bit-identically."""
+        with mat._lock:
+            if mat._fold is not None:
+                return mat._fold
+        fs = gh.ALL_GROUPS[mat.curve].scalar_field
+        lams, _ = self.lagrange_at_zero(mat.curve, tuple(quorum))
+        sigma = 0
+        for lam, x in zip(lams, quorum):
+            sigma = (sigma + lam * mat.shares[x - 1]) % fs.modulus
+        limbs = np.asarray(fh.encode(fs, [sigma]))[0]
+        with mat._lock:
+            if mat._fold is None:
+                mat._fold = limbs
+            return mat._fold
+
+    # -- per-quorum public keys ----------------------------------------------
+
+    def quorum_pks(
+        self, mat: CeremonyMaterial, quorum: list[int]
+    ) -> tuple[np.ndarray, list]:
+        """``(canonical (m, C, L) limbs, host tuples)`` of the quorum's
+        public keys, through the persistent fixed-base tables — built at
+        the quorum shape the solo path always compiled (no new shapes),
+        then cached per quorum tuple inside the ceremony entry."""
+        from .partial import public_keys
+
+        key = tuple(quorum)
+        with mat._lock:
+            hit = mat._pks.get(key)
+            if hit is not None:
+                mat._pks.move_to_end(key)
+                return hit
+        pks = public_keys(mat.curve, [mat.shares[x - 1] for x in quorum])
+        with mat._lock:
+            mat._pks[key] = pks
+            while len(mat._pks) > self.pk_capacity:
+                mat._pks.popitem(last=False)
+        return pks

@@ -81,12 +81,21 @@ class PartialSignatures:
     announcements: list[tuple] | None = None
 
     def sigs_host(self) -> list[list[tuple]]:
-        """Host point tuples, [message][signer]."""
+        """Host point tuples, [message][signer] — memoized: the prove
+        leg and rlc_verify both need the same conversion, and on the
+        steady-state lane paying the limb->int walk twice per convoy is
+        pure waste.  ``dataclasses.replace`` (how tampers fork a batch)
+        drops the memo with the instance, so forged copies re-derive."""
+        memo = getattr(self, "_host_rows", None)
+        if memo is not None:
+            return memo
         b, m = self.sigs.shape[:2]
         flat = gd.to_host(
             gd.ALL_CURVES[self.curve], self.sigs.reshape(b * m, *self.sigs.shape[2:])
         )
-        return [flat[i * m : (i + 1) * m] for i in range(b)]
+        rows = [flat[i * m : (i + 1) * m] for i in range(b)]
+        self._host_rows = rows
+        return rows
 
 
 def public_keys(curve: str, shares: list[int]) -> tuple[np.ndarray, list]:
@@ -98,6 +107,45 @@ def public_keys(curve: str, shares: list[int]) -> tuple[np.ndarray, list]:
     pts = gd.fixed_base_mul(cs, table, k)
     canon = gd.affine_canon_host(cs, np.asarray(pts))
     return canon, gd.to_host(cs, canon)
+
+
+def sign_folded(curve: str, sigma_limbs: np.ndarray, h_dev):
+    """Steady-state fast path: sign a message batch with the folded
+    quorum scalar in ONE ladder dispatch.
+
+    ``sigma_limbs``: canonical limbs of
+    sigma = sum_i lambda_i(0) * s_i (``sign.cache.SignCache.fold_limbs``)
+    — ``(L,)`` for one shared scalar, or ``(B, L)`` per-message rows (a
+    cross-ceremony convoy folds a different sigma per ticket).  By
+    interpolation at zero sigma is f(0), so ``sigma * H(m)`` IS the
+    aggregate signature, bit-identical to the partial-grid + MSM path
+    (pinned in tests/test_sign.py and asserted per steady-state bench
+    run).  ``h_dev``: ``(B, C, L)`` H(m) limbs (device or host array).
+
+    Returns the RAW device result — callers (the scheduler's sign lane)
+    keep rungs in flight and block/canonicalise per rung, overlapping
+    hashing of the next rung with the ladder of this one.  Unproved
+    shapes only: the grid path still serves ``prove=True`` traffic,
+    whose DLEQ transcripts need per-signer partials.
+    """
+    cs = gd.ALL_CURVES[curve]
+    hh = jnp.asarray(h_dev)
+    kk = jnp.asarray(sigma_limbs)
+    if kk.ndim == 1:
+        kk = jnp.broadcast_to(kk[None, :], (hh.shape[0], kk.shape[-1]))
+    # noqa-rationale: one call signs the whole (B,) batch — no loop.
+    return gd.scalar_mul(cs, kk, hh)  # noqa: DKG009
+
+
+def folded_collect(curve: str, pending: list) -> np.ndarray:
+    """Block on a list of in-flight :func:`sign_folded` dispatches and
+    canonicalise the lot: ``(sum of B's, C, L)`` affine limbs, ready for
+    ``aggregate.signature_encode``.  Split from :func:`sign_folded` so
+    the lane can keep every rung's ladder in flight before the first
+    host conversion blocks."""
+    cs = gd.ALL_CURVES[curve]
+    parts = [np.asarray(out) for out in pending]
+    return gd.affine_canon_host(cs, np.concatenate(parts, axis=0))
 
 
 def partial_sign_host(group: gh.HostGroup, shares: list[int], h_point) -> list[tuple]:
@@ -118,6 +166,7 @@ def partial_sign(
     prove: bool = False,
     dispatch: str | None = None,
     chunk: int | None = None,
+    pks: tuple[np.ndarray, list] | None = None,
 ) -> PartialSignatures:
     """Sign every message with every share: ``(B, m)`` partials.
 
@@ -125,6 +174,9 @@ def partial_sign(
     attaches per-(message, signer) DLEQ proofs (requires ``rng``).  The
     device leg runs the whole grid as one broadcast ladder per message
     chunk; the host leg is the oracle loop (cross-checks, tiny batches).
+    ``pks``: the ``(canon, host)`` pair :func:`public_keys` would
+    return, when the caller already holds it (``sign.cache.SignCache``
+    keeps them per quorum) — must match ``shares`` exactly.
     """
     if len(shares) != len(indices):
         raise ValueError("shares and indices must pair up")
@@ -144,7 +196,7 @@ def partial_sign(
         k = jnp.asarray(fh.encode(cs.scalar, shares))  # (m, L)
         h_dev = gd.from_host(cs, h_points)  # (B, C, L)
         csize = _sign_chunk(chunk)
-        parts = []
+        pending = []
         for b0 in range(0, b, csize):
             blk = h_dev[b0 : b0 + csize]
             bc = blk.shape[0]
@@ -155,10 +207,15 @@ def partial_sign(
             # noqa-rationale: each call covers a whole (B', m) grid —
             # the loop is DKG_TPU_SIGN_BATCH memory chunking over
             # messages, not a per-message mult.
-            out = gd.scalar_mul(cs, kk, pp)  # noqa: DKG009
-            parts.append(np.asarray(out))
+            pending.append(gd.scalar_mul(cs, kk, pp))  # noqa: DKG009
+        # dispatch-ahead (seal_shares_pipeline style): every chunk's
+        # ladder is in flight before the first np.asarray blocks, so
+        # host conversion of chunk k overlaps device work on k+1.
+        parts = [np.asarray(out) for out in pending]
         sigs = gd.affine_canon_host(cs, np.concatenate(parts, axis=0))
-    pks_canon, pks = public_keys(curve, shares)
+    if pks is None:
+        pks = public_keys(curve, shares)
+    pks_canon, pks = pks
     ps = PartialSignatures(
         curve=curve,
         indices=tuple(int(i) for i in indices),

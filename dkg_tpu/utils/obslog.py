@@ -296,9 +296,16 @@ EVENT_SCHEMA: dict[str, dict[str, tuple | None]] = {
         "required": ("op", "dur_s", "bytes_in", "bytes_out"), "optional": (),
     },
     "hub_junk_frame": {"required": ("reason",), "optional": ("op",)},
-    # spans (tracing.phase_span / service scheduler)
+    # spans (tracing.phase_span / service scheduler).  The sign lane's
+    # ``sign_convoy`` spans annotate the convoy composition: curve,
+    # request/message/ceremony counts, proved flag, flush reason, and
+    # how many tickets ended in error.
     "span": {
-        "required": ("name", "ts0", "mono0", "dur_s"), "optional": ("subs",),
+        "required": ("name", "ts0", "mono0", "dur_s"),
+        "optional": (
+            "subs", "curve", "requests", "messages", "ceremonies",
+            "proved", "reason", "errors",
+        ),
     },
     # open kinds: payload varies by probe/deployment (utils.runtimeobs,
     # dkg_tpu.service) — base-field conformance only

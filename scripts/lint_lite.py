@@ -108,6 +108,14 @@ linter), so the committed baseline stays clean between CI runs:
         every transport byte must flow through them so the
         ``net_wire_bytes_total{dir,op}`` accounting stays exact
         (docs/observability.md, "Wire accounting")
+* DKG013  (dkg_tpu/service/ only) per-request re-derivation of
+        quorum-stable signing material: a ``lagrange_at_zero_coeffs`` /
+        ``lagrange_coefficient`` / ``public_keys`` call — the sign
+        lane's hot path must take Lagrange coefficients, pk ladders,
+        and decoded shares from ``sign.cache.SignCache`` (cached per
+        (curve, quorum) / (ceremony, epoch)), because SIGN_r01 measured
+        exactly this re-derivation dominating steady-state signing
+        (docs/signing.md "Steady-state lane")
 
 Exit 0 = clean.  Run: ``python scripts/lint_lite.py`` (from repo root).
 Also executed by tests/test_import_hygiene.py so the default test tier
@@ -217,8 +225,10 @@ _DKG010_RECORDERS = {
     "emit_span",
     "_emit",
     "_isolate",
+    "_isolate_sign",
     "_fail_convoy",
     "_poison_one",
+    "_poison_sign_one",
     "_retry_transient",
     "_note",
     "record_done",
@@ -250,6 +260,17 @@ _DKG012_WIRE_HELPERS = {"_wire_send", "_CountedReader"}
 # whose name ends in ``_host`` are the allowlisted big-int oracle legs
 # (bit-exactness references, never hot paths).
 _SIGN_HOST_ORACLE_SUFFIX = "_host"
+
+# Quorum-stable derivations banned in dkg_tpu/service/ (DKG013): the
+# sign lane must take this material from sign.cache.SignCache — calling
+# these per request is the re-derivation SIGN_r01 measured dominating
+# the steady state.  (sign/cache.py itself, in dkg_tpu/sign/, is the
+# one sanctioned caller.)
+_DKG013_CACHED_DERIVATIONS = {
+    "lagrange_at_zero_coeffs",
+    "lagrange_coefficient",
+    "public_keys",
+}
 
 
 class _Checker(ast.NodeVisitor):
@@ -675,6 +696,18 @@ class _Checker(ast.NodeVisitor):
                     "worker pool (service/scheduler.py) and the scrape "
                     "server (service/httpobs.py) are the only sanctioned "
                     "thread/process spawn sites",
+                )
+            # DKG013: quorum-stable signing material is cached — a
+            # direct Lagrange/pk derivation in service code is the
+            # per-request re-derivation the steady-state lane removed.
+            if name in _DKG013_CACHED_DERIVATIONS:
+                self._add(
+                    node,
+                    "DKG013",
+                    f"{name}() in dkg_tpu/service/ — take Lagrange "
+                    "coefficients / pk ladders / decoded shares from "
+                    "sign.cache.SignCache (per-request re-derivation is "
+                    "the SIGN_r01 steady-state pathology)",
                 )
         # DKG008: epoch code must scale like the ceremony — EC scalar
         # mults go through the batched entry points (epoch/dealing.py),

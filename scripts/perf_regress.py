@@ -52,7 +52,10 @@ shape — FAIL when a shape's ``partials_per_s`` dropped more than the
 threshold (proof and aggregate rates are informational: they carry
 host-side Fiat-Shamir hashing and single-dispatch MSM noise).  Shapes
 present in only one round, or rounds from different platforms, skip
-with a note.
+with a note.  Rounds carrying a ``steady_state`` block (sign_bench
+``--steady``: the scheduler lane's warm throughput) additionally gate
+``steady_state.signatures_per_s`` the same way; an older round that
+predates steady-state mode skips that leg with a note.
 
 The service chaos storm: ``SVCSTORM_r{NN}.json`` rounds
 (scripts/service_storm.py) gate FLOORS on the newest round rather than
@@ -550,7 +553,59 @@ def sign_gate(root: pathlib.Path, threshold: float) -> int:
             f"perf_regress: sign r{old_n} and r{new_n} share no usable "
             "shapes — nothing to diff"
         )
+    bad |= _steady_gate(old_n, old, new_n, new, threshold)
     return bad
+
+
+def _steady_gate(
+    old_n: int, old: dict, new_n: int, new: dict, threshold: float
+) -> int:
+    """Gate ``steady_state.signatures_per_s`` — the sign lane's warm
+    throughput headline — between the newest two rounds.  Rounds that
+    predate steady-state mode (no block) skip with a note; shape
+    mismatches (different curve/n/batch) are incomparable and skip."""
+
+    def usable(doc: dict) -> dict | None:
+        s = doc.get("steady_state")
+        if (
+            isinstance(s, dict)
+            and s.get("correct")
+            and isinstance(s.get("signatures_per_s"), (int, float))
+            and s["signatures_per_s"] > 0
+        ):
+            return s
+        return None
+
+    old_s, new_s = usable(old), usable(new)
+    if old_s is None or new_s is None:
+        which = f"r{old_n}" if old_s is None else f"r{new_n}"
+        print(
+            f"perf_regress: sign {which} carries no usable steady_state "
+            "block (predates --steady mode?) — steady gate skipped"
+        )
+        return 0
+    old_key = (old_s.get("curve"), old_s.get("n"), old_s.get("batch"))
+    new_key = (new_s.get("curve"), new_s.get("n"), new_s.get("batch"))
+    if old_key != new_key:
+        print(
+            f"perf_regress: sign steady shapes differ "
+            f"(r{old_n} {old_key} vs r{new_n} {new_key}) "
+            "— incomparable, skipping"
+        )
+        return 0
+    old_v, new_v = old_s["signatures_per_s"], new_s["signatures_per_s"]
+    change = (new_v - old_v) / old_v
+    curve, n, batch = new_key
+    line = (
+        f"perf_regress: sign steady {curve} n={n} batch={batch} "
+        f"signatures_per_s r{old_n} {old_v:.1f} -> r{new_n} {new_v:.1f} "
+        f"({change:+.1%})"
+    )
+    if change < -threshold:
+        print(f"{line} — REGRESSION beyond {threshold:.0%}", file=sys.stderr)
+        return 1
+    print(line)
+    return 0
 
 
 def _load_svcstorm_rounds(root: pathlib.Path) -> list[tuple[int, dict]]:

@@ -345,6 +345,33 @@ def test_threshold_signature_matches_master_scalar(curve):
     assert sigs == ctx["expected_sig"]
 
 
+@pytest.mark.parametrize("curve", TIERED_CURVES)
+def test_sign_cache_lagrange_limbs_match_device(curve):
+    """SignCache.lagrange_at_zero is limb-identical to the batched
+    device derivation — the parity that lets the lane feed cached
+    lambdas into aggregate(lam=...) and fold sigma = f(0) on host while
+    staying bit-compatible with the device path."""
+    from dkg_tpu.fields import host as fh
+    from dkg_tpu.poly import device as pd
+    from dkg_tpu.sign.cache import SignCache
+
+    cs = gd.ALL_CURVES[curve]
+    cache = SignCache()
+    xs = (1, 2, 3)
+    lams, limbs = cache.lagrange_at_zero(curve, xs)
+    dev = np.asarray(
+        pd.lagrange_at_zero_coeffs(
+            cs.scalar, np.asarray(fh.encode(cs.scalar, list(xs)))
+        )
+    )
+    assert np.array_equal(limbs, dev), "cached lambdas must be bit-exact"
+    assert cache.lagrange_at_zero(curve, xs)[1] is limbs, "second call hits"
+    # and aggregate(lam=cached) encodes the identical signature bytes
+    ctx = _ctx(curve)
+    sigs = sg.signature_encode(curve, sg.aggregate(ctx["ps"], lam=limbs))
+    assert sigs == ctx["expected_sig"]
+
+
 # ------------------------------------------------------------ epoch invariance
 
 

@@ -1,0 +1,262 @@
+"""The scheduler's steady-state sign lane: convoy flush policy,
+cross-ceremony coalescing, poisoned-ticket isolation, and the warm-path
+cache's epoch invalidation.
+
+Everything here fakes the lane's engine surface (``_sign_execute`` — an
+instance-attribute monkeypatch, the same idiom tests/test_service.py
+uses for start/finish_convoy) so the tests exercise ONLY the queueing,
+flushing, delivery, and isolation machinery: no curve math, no jit
+compiles, sub-second in the default tier.  Byte-level parity of the
+real legs (folded fast path vs. grid vs. host oracle, cached lambdas vs.
+the device derivation) is pinned in tests/test_sign.py and asserted per
+steady-state bench run (scripts/sign_bench.py --steady).
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from dkg_tpu.fields import host as fh
+from dkg_tpu.groups import host as gh
+from dkg_tpu.service import errors
+from dkg_tpu.service.engine import CeremonyOutcome
+from dkg_tpu.service.scheduler import CeremonyScheduler
+from dkg_tpu.sign.cache import SignCache
+from dkg_tpu.utils.metrics import MetricsRegistry
+
+CURVE = "secp256k1"
+N, T = 5, 2
+
+
+def _shares(curve: str = CURVE, seed: int = 0x1A7E) -> tuple[int, list[int]]:
+    """Seeded (N, T) Shamir sharing (secret, shares at 1..N)."""
+    fs = gh.ALL_GROUPS[curve].scalar_field
+    rng = random.Random(seed)
+    coeffs = [fs.rand_int(rng) for _ in range(T + 1)]
+
+    def horner(x: int) -> int:
+        acc = 0
+        for c in reversed(coeffs):
+            acc = (acc * x + c) % fs.modulus
+        return acc
+
+    return coeffs[0], [horner(i) for i in range(1, N + 1)]
+
+
+def _outcome(cid: str, epoch: int = 0) -> CeremonyOutcome:
+    fs = gh.ALL_GROUPS[CURVE].scalar_field
+    _, shares = _shares()
+    return CeremonyOutcome(
+        ceremony_id=cid, status="done", curve=CURVE, n=N, t=T,
+        master=b"m", qualified=(True,) * N,
+        final_shares=np.asarray(fh.encode(fs, shares)),
+        epoch=epoch,
+    )
+
+
+def _scheduler(**kw):
+    kw.setdefault("concurrency", 1)
+    kw.setdefault("queue_depth", 4)
+    kw.setdefault("batch_max", 1)
+    kw.setdefault("runtime", object())
+    kw.setdefault("metrics", MetricsRegistry())
+    return CeremonyScheduler(**kw)
+
+
+def _hold(sch, *cids):
+    for cid in cids:
+        out = _outcome(cid)
+        with sch._cond:
+            sch._record(out)
+
+
+def _fake_sigs(msgs: list[bytes]) -> list[bytes]:
+    """The fake engine's deterministic output — what 'solo path bytes'
+    means inside these tests."""
+    return [b"sig:" + m for m in msgs]
+
+
+class _FakeLane:
+    """Records every (sub-)convoy handed to ``_sign_execute`` and signs
+    each live ticket with :func:`_fake_sigs`; raises whole-convoy when a
+    poison marker is aboard (before concluding ANY ticket, mimicking a
+    shared-dispatch blowup)."""
+
+    def __init__(self, poison_marker: bytes | None = None):
+        self.convoys: list[list] = []
+        self.poison_marker = poison_marker
+
+    def __call__(self, convoy, subs):
+        self.convoys.append(list(convoy))
+        if self.poison_marker is not None and any(
+            self.poison_marker in p.msgs for p in convoy
+        ):
+            raise RuntimeError("fake engine hit the poison marker")
+        for p in convoy:
+            if p.error is None and p.sigs is None:
+                p.sigs = _fake_sigs(p.msgs)
+
+
+def test_sign_lane_deadline_flush():
+    """An under-cap ticket flushes when the head ages past
+    DKG_TPU_SIGN_FLUSH_MS — reason 'deadline' — and its waiter gets the
+    engine's bytes."""
+    sch = _scheduler(sign_flush_ms=20, sign_batch_max=256)
+    try:
+        fake = _FakeLane()
+        sch._sign_execute = fake
+        _hold(sch, "solo")
+        msgs = [b"d0", b"d1"]
+        assert sch.sign("solo", msgs, prove=False) == _fake_sigs(msgs)
+        assert len(fake.convoys) == 1 and len(fake.convoys[0]) == 1
+        snap = sch.metrics.snapshot()
+        assert snap["counters"]['sign_flush_total{reason="deadline"}'] == 1
+        assert snap["counters"]["sign_convoys_total"] == 1
+        assert snap["counters"]['sign_requests_total{ceremony="solo"}'] == 1
+        assert snap["gauges"]["sign_queue_depth"] == 0
+        assert 'sign_seconds{ceremony="solo"}' in snap["histograms"]
+    finally:
+        sch.close()
+
+
+def test_sign_lane_batch_max_flush():
+    """With a long deadline, queued tickets coalesce until the message
+    cap and flush with reason 'full' — one convoy, every waiter served."""
+    sch = _scheduler(sign_flush_ms=5000, sign_batch_max=4)
+    try:
+        fake = _FakeLane()
+        sch._sign_execute = fake
+        _hold(sch, "cap")
+        t1 = sch.sign_submit("cap", [b"f0", b"f1"], prove=False)
+        t2 = sch.sign_submit("cap", [b"f2", b"f3"], prove=False)
+        assert sch.sign_wait(t1, timeout=10) == _fake_sigs([b"f0", b"f1"])
+        assert sch.sign_wait(t2, timeout=10) == _fake_sigs([b"f2", b"f3"])
+        assert len(fake.convoys) == 1, "both tickets must share one convoy"
+        assert len(fake.convoys[0]) == 2
+        snap = sch.metrics.snapshot()["counters"]
+        assert snap['sign_flush_total{reason="full"}'] == 1
+        assert snap.get('sign_flush_total{reason="deadline"}', 0) == 0
+    finally:
+        sch.close()
+
+
+def test_sign_lane_cross_ceremony_coalescing():
+    """Tickets from DIFFERENT ceremonies sharing (curve, prove) ride one
+    convoy — the cross-tenant batching the lane exists for — while the
+    terminal metrics stay per-ceremony."""
+    sch = _scheduler(sign_flush_ms=5000, sign_batch_max=2)
+    try:
+        fake = _FakeLane()
+        sch._sign_execute = fake
+        _hold(sch, "tenant-a", "tenant-b")
+        ta = sch.sign_submit("tenant-a", [b"xa"], prove=False)
+        tb = sch.sign_submit("tenant-b", [b"xb"], prove=False)
+        assert sch.sign_wait(ta, timeout=10) == _fake_sigs([b"xa"])
+        assert sch.sign_wait(tb, timeout=10) == _fake_sigs([b"xb"])
+        assert len(fake.convoys) == 1
+        assert {p.cid for p in fake.convoys[0]} == {"tenant-a", "tenant-b"}
+        snap = sch.metrics.snapshot()["counters"]
+        assert snap['sign_requests_total{ceremony="tenant-a"}'] == 1
+        assert snap['sign_requests_total{ceremony="tenant-b"}'] == 1
+        assert snap["sign_convoys_total"] == 1
+    finally:
+        sch.close()
+
+
+def test_sign_lane_poisons_culprit_and_preserves_mates():
+    """A convoy-wide blowup bisects down to the marker ticket, which
+    fails typed PoisonedRequest; its convoy-mates complete with bytes
+    identical to running alone (the blast-radius contract)."""
+    sch = _scheduler(sign_flush_ms=5000, sign_batch_max=3)
+    try:
+        fake = _FakeLane(poison_marker=b"POISON")
+        sch._sign_execute = fake
+        _hold(sch, "good-a", "bad", "good-c")
+        ta = sch.sign_submit("good-a", [b"pa"], prove=False)
+        tb = sch.sign_submit("bad", [b"POISON"], prove=False)
+        tc = sch.sign_submit("good-c", [b"pc"], prove=False)
+        assert sch.sign_wait(ta, timeout=10) == _fake_sigs([b"pa"])
+        assert sch.sign_wait(tc, timeout=10) == _fake_sigs([b"pc"])
+        with pytest.raises(errors.PoisonedRequest, match="RuntimeError"):
+            sch.sign_wait(tb, timeout=10)
+        assert len(fake.convoys[0]) == 3, "all three coalesced first"
+
+        snap = sch.metrics.snapshot()["counters"]
+        assert snap['sign_poisoned_total{ceremony="bad"}'] == 1
+        assert snap["sign_bisections_total"] >= 1
+        # the poisoned ticket never counts as served
+        assert 'sign_requests_total{ceremony="bad"}' not in snap
+        assert snap['sign_requests_total{ceremony="good-a"}'] == 1
+        assert snap['sign_requests_total{ceremony="good-c"}'] == 1
+
+        # and the lane stays healthy: a solo re-run of a mate through
+        # the SAME lane returns the identical bytes
+        assert sch.sign("good-a", [b"pa"], prove=False) == _fake_sigs([b"pa"])
+    finally:
+        sch.close()
+
+
+def test_sign_lane_precondition_errors_on_callers_thread():
+    """sign_submit keeps the synchronous path's precondition surface:
+    unknown ceremony raises KeyError before anything enqueues."""
+    sch = _scheduler(sign_flush_ms=10, sign_batch_max=4)
+    try:
+        sch._sign_execute = _FakeLane()
+        with pytest.raises(KeyError, match="unknown ceremony"):
+            sch.sign_submit("nobody", [b"x"])
+        assert sch.sign("whoever", []) == []  # empty batch short-circuit
+    finally:
+        sch.close()
+
+
+def test_sign_rung_slices_cover_exactly():
+    """The message-rung ladder decomposes any total exactly (tail rungs
+    2 and 1 guarantee coverage) and respects the convoy cap."""
+    from dkg_tpu.service import buckets
+
+    assert buckets.sign_rung_slices(0) == []
+    assert buckets.sign_rung_slices(21) == [(0, 16), (16, 20), (20, 21)]
+    with pytest.raises(ValueError):
+        buckets.sign_rung_slices(-1)
+    for total in (1, 2, 3, 7, 64, 65, 300):
+        for cap in (256, 64, 7, 1):
+            slices = buckets.sign_rung_slices(total, cap)
+            assert [a for a, _ in slices] == [0] + [b for _, b in slices[:-1]]
+            assert slices[-1][1] == total
+            assert all(b - a <= cap for a, b in slices)
+            assert all(
+                (b - a) in buckets.SIGN_RUNGS for a, b in slices
+            )
+
+
+def test_sign_cache_epoch_bump_invalidates():
+    """The (ceremony, epoch) key IS the invalidation: a bump makes the
+    stale entry unreachable and proactively evicts it, and the folded
+    scalar re-derives against the new shares."""
+    fs = gh.ALL_GROUPS[CURVE].scalar_field
+    secret, shares = _shares()
+    enc = np.asarray(fh.encode(fs, shares))
+    cache = SignCache()
+
+    m0 = cache.ceremony("cid", 0, CURVE, enc)
+    assert m0.shares == tuple(shares)
+    assert cache.ceremony("cid", 0, CURVE, enc) is m0, "same epoch hits"
+    assert cache.hits == 1 and cache.misses == 1
+
+    # sigma == f(0): the fold equals the secret regardless of quorum
+    fold = cache.fold_limbs(m0, [1, 2, 3])
+    assert np.array_equal(fold, np.asarray(fh.encode(fs, [secret]))[0])
+    assert cache.fold_limbs(m0, [2, 4, 5]) is fold, "cached per epoch"
+
+    # epoch bump (what refresh/reshare CAS does): new key, stale evicted
+    secret2, shares2 = _shares(seed=0x2B5D)
+    enc2 = np.asarray(fh.encode(fs, shares2))
+    m1 = cache.ceremony("cid", 1, CURVE, enc2)
+    assert m1 is not m0 and m1.shares == tuple(shares2)
+    assert ("cid", 0) not in cache._ceremonies, "stale epoch evicted"
+    fold2 = cache.fold_limbs(m1, [1, 2, 3])
+    assert np.array_equal(fold2, np.asarray(fh.encode(fs, [secret2]))[0])
+    assert not np.array_equal(fold, fold2)
