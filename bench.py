@@ -86,13 +86,33 @@ def sync(tree) -> None:
         np.asarray(leaf[(0,) * leaf.ndim] if leaf.ndim else leaf)
 
 
+def _bench_repeats() -> int:
+    import os
+
+    try:
+        return max(1, int(os.environ.get("DKG_TPU_BENCH_REPEATS", "3")))
+    except ValueError:
+        return 3
+
+
 def timed(fn, *args):
+    """Warm once, then time ``DKG_TPU_BENCH_REPEATS`` passes (default 3)
+    and keep the FASTEST.  Elapsed-time noise on a shared box is
+    strictly additive (scheduler preemption, cache pollution from the
+    neighbouring phase), so min is the standard location estimator for
+    the code's own cost — six single-shot runs of an identical build
+    swung individual phase rates by >20% on the 1-core CI box, past
+    perf_regress's own tolerance, which is exactly the flakiness this
+    buys back for ~6s of extra rung wall."""
     out = fn(*args)
     sync(out)  # drain compile + any queued work
-    t0 = time.perf_counter()
-    out = fn(*args)
-    sync(out)
-    return out, time.perf_counter() - t0
+    best = float("inf")
+    for _ in range(_bench_repeats()):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        sync(out)
+        best = min(best, time.perf_counter() - t0)
+    return out, best
 
 
 def parity_check(curve: str = "secp256k1", n: int = 64, t: int = 21) -> bool:
@@ -362,6 +382,10 @@ def _rung_child(curve: str, n: int, t: int) -> None:
     runtimeobs.install(force=True)
     t_deal, t_verify, t_rho, fs_sub, table, seal = run(curve, n, t)
     runtimeobs.sample_memory()
+    from dkg_tpu.fields import device as fd
+    from dkg_tpu.groups import device as gd
+
+    cs = gd.ALL_CURVES[curve]
     print(
         json.dumps(
             {
@@ -387,6 +411,112 @@ def _rung_child(curve: str, n: int, t: int) -> None:
                 "warm": table["stats"].get("builds", 0) == 0,
                 "table_stats": table["stats"],
                 "pallas": _pallas_active(),
+                # which fd.mul formulation the measured ceremony traced
+                # (fields.device.mul_dispatch_mode) — alongside
+                # digest_dispatch so a dispatch flip between rounds is
+                # visible in the artifact, not just in wall clock
+                "mul_dispatch": {
+                    "base": fd.mul_dispatch_mode(cs.field),
+                    "scalar": fd.mul_dispatch_mode(cs.scalar),
+                },
+            }
+        )
+    )
+
+
+def _pallas_child() -> None:
+    """Kernel-tier leg: validate the fused MXU multiply kernel
+    bit-exactly against the XLA path, microbench ``fd.mul`` under every
+    dispatch (classic / gemm twin / Pallas MXU kernel) on one 2048-lane
+    batch, and record the Pippenger scatter-pass memory evidence — the
+    XLA scan leg's compiled temp bytes at m=512 vs the bucket kernel's
+    analytic VMEM residency (the kernel's whole working set; its CPU
+    compile is pathological, so the Mosaic tier measures it live —
+    scripts/mosaic_check.py).
+
+    On CPU backends the kernel runs in interpret mode: the bit-exactness
+    bit is real verification, the kernel's wall time is NOT a perf
+    number (interpret emulates the Mosaic program op by op) and is
+    labeled ``mode: interpret`` so consumers never diff it against a
+    Mosaic round.
+    """
+    import os
+
+    _configure_cache()
+    import numpy as np
+
+    from dkg_tpu.fields import device as fd
+    from dkg_tpu.fields import host as fh
+    from dkg_tpu.fields.spec import ALL_FIELDS
+    from dkg_tpu.groups import device as gd
+    from dkg_tpu.ops import pallas_mxu as pm
+
+    fs = ALL_FIELDS["secp256k1_base"]
+    rng = random.Random(0x9E11A5)
+    lanes = 2048
+    a = jnp.asarray(fh.encode(fs, [fs.rand_int(rng) for _ in range(lanes)]))
+    b = jnp.asarray(fh.encode(fs, [fs.rand_int(rng) for _ in range(lanes)]))
+    want = fd.mul(fs, a, b)
+    got = pm.mxu_mod_mul(fs, a, b)
+    exact = bool((np.asarray(got) == np.asarray(want)).all())
+
+    # per-dispatch fd.mul microbench: a FRESH jit wrapper per mode —
+    # the jit cache does not key on the DKG_TPU_MUL knob, so reusing
+    # one traced program would silently time the first mode three times
+    mul_ms = {}
+    saved = os.environ.get("DKG_TPU_MUL")
+    try:
+        for mode in ("classic", "gemm"):
+            os.environ["DKG_TPU_MUL"] = mode
+            f = jax.jit(lambda x, y: fd.mul(fs, x, y))
+            _, s = timed(f, a, b)
+            mul_ms[mode] = round(s * 1e3, 3)
+    finally:
+        if saved is None:
+            os.environ.pop("DKG_TPU_MUL", None)
+        else:
+            os.environ["DKG_TPU_MUL"] = saved
+    _, s = timed(lambda: pm.mxu_mod_mul(fs, a, b))
+    mul_ms["pallas_mxu"] = round(s * 1e3, 3)
+
+    # scatter-pass memory: compile (never run) the scan leg at the
+    # window-8 MSM shape and read XLA's own temp-buffer accounting
+    cs = gd.ALL_CURVES["secp256k1"]
+    m = 512
+    window = gd.pippenger_window(m, cs.name)
+    entries = 1 << window
+    nw = min(gd._n_windows(cs, window), -(-256 // window))
+    L, C = cs.field.limbs, cs.ncoords
+    pts = jnp.zeros((m, C, L), jnp.uint32)
+    digs = jnp.zeros((m, nw), jnp.int32)
+    scan_temp = None
+    try:
+        comp = (
+            jax.jit(lambda p, d: gd._bucket_scan(cs, p, d, entries))
+            .lower(pts, digs)
+            .compile()
+        )
+        scan_temp = int(comp.memory_analysis().temp_size_in_bytes)
+    except Exception as exc:  # noqa: BLE001 — accounting is evidence, not a gate
+        print(f"bucket scan memory probe failed: {exc}", file=sys.stderr)
+    # the kernel leg's whole scatter working set is the one VMEM-resident
+    # bucket tile per batch element (plus the point/digit blocks); the
+    # scan leg instead round-trips that same tensor through HBM as
+    # loop-carried state — once in, once out, per point
+    bucket_bytes = C * L * nw * entries * 4
+    print(
+        json.dumps(
+            {
+                "exact": exact,
+                "mode": "mosaic" if jax.default_backend() == "tpu" else "interpret",
+                "field": fs.name,
+                "lanes": lanes,
+                "fd_mul_ms": mul_ms,
+                "msm_m": m,
+                "bucket_scan_temp_bytes": scan_temp,
+                "bucket_kernel_vmem_bytes": bucket_bytes,
+                "bucket_hbm_bytes_scan": 2 * bucket_bytes * m,
+                "bucket_hbm_bytes_kernel": bucket_bytes,
             }
         )
     )
@@ -497,6 +627,20 @@ def run(curve: str, n: int, t: int, rho_bits: int = 128):
     t0 = time.perf_counter()
     rho = jnp.asarray(ce.derive_rho(cfg, a, e, s, r, rho_bits, trace=fs_trace))
     t_rho = time.perf_counter() - t0
+    # The host leg (numpy BLAKE2s) has nothing to warm — the cold-call
+    # doctrine above is about device-leg compile cost — so it gets the
+    # same best-of-N treatment as every timed() phase.  The device leg
+    # stays a single cold call: its first-call compile IS the cost.
+    if fs_trace.meta.get("digest_dispatch") == "host":
+        for _ in range(_bench_repeats() - 1):
+            tr_i = CeremonyTrace()
+            t0 = time.perf_counter()
+            rho_i = jnp.asarray(
+                ce.derive_rho(cfg, a, e, s, r, rho_bits, trace=tr_i)
+            )
+            dt = time.perf_counter() - t0
+            if dt < t_rho:
+                t_rho, fs_trace, rho = dt, tr_i, rho_i
     fs_sub = {
         "sub_s": dict(fs_trace.subtimings_s.get("fiat_shamir", {})),
         "dispatch": fs_trace.meta.get("digest_dispatch"),
@@ -794,6 +938,13 @@ def main():
             kem = None
             if platform == "tpu" and os.environ.get("DKG_TPU_BENCH_KEM") != "0":
                 kem = kem_rung()
+            # kernel-tier leg: MXU-kernel bit-exactness, per-dispatch
+            # fd.mul microbench, scatter-pass memory evidence — its own
+            # killable child (an interpret-mode compile stall must cost
+            # this block, never the headline)
+            pallas_sec = None
+            if os.environ.get("DKG_TPU_BENCH_PALLAS") != "0":
+                pallas_sec = _child("import bench; bench._pallas_child()", 900.0)
         finally:
             for k in extra_env:
                 if saved.get(k) is None:
@@ -831,7 +982,17 @@ def main():
                         },
                         "warm": res.get("warm"),
                         "table_stats": res.get("table_stats"),
-                        "pallas": res["pallas"],
+                        # the kernel-tier headline: did this round
+                        # validate the fused Pallas kernels bit-exactly
+                        # (pallas_kernels block below)?  The ceremony's
+                        # own fused flag moved to pallas_ceremony —
+                        # perf_regress keys comparability on THAT (with
+                        # this key as the older rounds' fallback)
+                        "pallas": bool((pallas_sec or {}).get("exact")),
+                        "pallas_mode": (pallas_sec or {}).get("mode"),
+                        "pallas_ceremony": res["pallas"],
+                        "pallas_kernels": pallas_sec,
+                        "mul_dispatch": res.get("mul_dispatch"),
                         # durable party checkpointing armed in the measured
                         # environment (fsync'd WAL journaling changes wall
                         # clock): rounds differing here are incomparable —

@@ -117,6 +117,23 @@ class FieldSpec:
         return _build_linred(self)
 
     @functools.cached_property
+    def mulred(self) -> "MulReduceSpec | None":
+        """Constants for the fused multiply-reduce (fields.device._mul_gemm
+        and ops.pallas_mxu), or ``None`` when the field fails admission.
+
+        Where ``linred`` folds an already-normalized 2L-limb product,
+        this folds the *unnormalized* schoolbook product columns
+        directly — the 2L-limb carry scan between mul_wide and the
+        reducer disappears.  Each high column P_c (c >= L, < 2**22) is
+        split into three bytes with residues 2**(16c + 8t) mod p, plus
+        the one spill digit P_{L-1} >> 16 with residue 2**(16L) mod p:
+        3L+1 digits, one exact f32 GEMM, then the same scan-free column
+        folds and quotient table as ``linred``.  All bounds are proved
+        with exact Python ints at admission time.
+        """
+        return _build_mulred(self)
+
+    @functools.cached_property
     def fold_limbs(self) -> np.ndarray | None:
         """Pseudo-Mersenne fold constant ``c = b**L mod p`` as limbs, or
         ``None`` when the field is not fold-friendly.
@@ -166,35 +183,45 @@ class LinearReduceSpec:
     np_limbs: np.ndarray  # (L+1,) uint32: b**(L+1) - p  (adds as "-p")
 
 
-def _build_linred(fs: FieldSpec) -> LinearReduceSpec | None:
-    """Derive and *prove* the linear-fold reduction constants.
+@dataclasses.dataclass(frozen=True)
+class MulReduceSpec:
+    """Precomputed constants for the fused multiply-reduce
+    (``fields.device._mul_gemm`` and the ``ops.pallas_mxu`` kernel).
 
-    The device algorithm (fields.device.linear_reduce) is replayed here
-    over per-column integer upper bounds; any violated invariant makes
-    the field inadmissible (returns None) rather than silently wrong.
+    Digit order (the device code must build digits in exactly this
+    order): for the unnormalized product columns P_c,
+
+    * digits [0, L)      — byte 0 of P_c, c = L .. 2L-1
+    * digits [L, 2L)     — byte 1 of P_c, c = L .. 2L-1
+    * digits [2L, 3L)    — byte 2 of P_c (< 2**6), c = L .. 2L-1
+    * digit  3L          — P_{L-1} >> 16 (< 2**6), residue b**L mod p
+
+    Every array is a compile-time constant; every bound was verified
+    with exact integer arithmetic in :func:`_build_mulred`.
+    """
+
+    foldm: np.ndarray  # (3L+1, 2L) float32: foldm[i, m] = byte m of R_i
+    c_limbs: np.ndarray  # (L,) uint32: c = b**L mod p
+    n_split: int  # scan-free column-fold iterations
+    shift_e: int  # quotient index = value >> (16*(L-1) + shift_e)
+    qtable: np.ndarray  # (u_max+1,) uint32: floor(u * 2**s / p)
+    np_limbs: np.ndarray  # (L+1,) uint32: b**(L+1) - p  (adds as "-p")
+
+
+def _fold_tail(fs: FieldSpec, colb: list) -> tuple | None:
+    """Shared tail of the linear-fold admission proofs: replay the
+    scan-free column folds and derive the quotient table over exact
+    per-column integer bounds ``colb``.
+
+    Returns ``(n_split, shift_e, qtable, np_limbs, c)`` or ``None``
+    when any invariant fails (inadmissible rather than silently wrong).
     """
     L, p, b = fs.limbs, fs.modulus, 1 << LIMB_BITS
     col_cap = (1 << 32) - (1 << LIMB_BITS)  # normalize()'s input contract
-
-    # Step 1: byte-matrix fold of the high L limbs.
-    d_consts = [(1 << (8 * k + LIMB_BITS * L)) % p for k in range(2 * L)]
-    fold8 = np.zeros((2 * L, 2 * L), np.float32)
-    for k, dk in enumerate(d_consts):
-        for m in range(2 * L):
-            fold8[k, m] = (dk >> (8 * m)) & 0xFF
-    f8i = fold8.astype(np.int64)
-    # exact-float32 guard on the contraction's column sums
-    if int((255 * f8i.sum(axis=0)).max()) >= 1 << 24:
-        return None
-    s16 = [
-        int(255 * f8i[:, 2 * j].sum() + 256 * 255 * f8i[:, 2 * j + 1].sum())
-        for j in range(L)
-    ]
-    colb = [(b - 1) + s for s in s16]  # + low limb of the input
     if max(colb) > col_cap:
         return None
 
-    # Step 2: scan-free column folds — top spill times c = b**L mod p.
+    # scan-free column folds — top spill times c = b**L mod p.
     c = (1 << (LIMB_BITS * L)) % p
     c_l = [int(v) for v in int_to_limbs(c, L)]
     vb = sum(cb << (LIMB_BITS * j) for j, cb in enumerate(colb))
@@ -216,9 +243,9 @@ def _build_linred(fs: FieldSpec) -> LinearReduceSpec | None:
     if vb >= 1 << (LIMB_BITS * (L + 1)):  # must normalize into L+1 limbs
         return None
 
-    # Step 3/4: quotient-estimate table over the top ~12 bits.  With the
-    # index u = floor(v / 2**s) and 2**s <= p, the true quotient is
-    # qtable[u] or qtable[u] + 1 — one conditional subtraction fixes it.
+    # quotient-estimate table over the top ~12 bits.  With the index
+    # u = floor(v / 2**s) and 2**s <= p, the true quotient is qtable[u]
+    # or qtable[u] + 1 — one conditional subtraction fixes it.
     u_full_bits = (vb >> (LIMB_BITS * (L - 1))).bit_length()
     shift_e = max(0, u_full_bits - 12)
     s = LIMB_BITS * (L - 1) + shift_e
@@ -229,11 +256,108 @@ def _build_linred(fs: FieldSpec) -> LinearReduceSpec | None:
         return None
     qtable = np.array([(u << s) // p for u in range(u_max + 1)], np.uint32)
     q_max = vb // p
-    if (b - 1) + q_max * (b - 1) > col_cap:  # step-5 column bound
+    if (b - 1) + q_max * (b - 1) > col_cap:  # final-fold column bound
         return None
     np_limbs = int_to_limbs((1 << (LIMB_BITS * (L + 1))) - p, L + 1)
+    return n_split, shift_e, qtable, np_limbs, c
+
+
+def _build_linred(fs: FieldSpec) -> LinearReduceSpec | None:
+    """Derive and *prove* the linear-fold reduction constants.
+
+    The device algorithm (fields.device.linear_reduce) is replayed here
+    over per-column integer upper bounds; any violated invariant makes
+    the field inadmissible (returns None) rather than silently wrong.
+    """
+    L, p, b = fs.limbs, fs.modulus, 1 << LIMB_BITS
+
+    # Step 1: byte-matrix fold of the high L limbs.
+    d_consts = [(1 << (8 * k + LIMB_BITS * L)) % p for k in range(2 * L)]
+    fold8 = np.zeros((2 * L, 2 * L), np.float32)
+    for k, dk in enumerate(d_consts):
+        for m in range(2 * L):
+            fold8[k, m] = (dk >> (8 * m)) & 0xFF
+    f8i = fold8.astype(np.int64)
+    # exact-float32 guard on the contraction's column sums
+    if int((255 * f8i.sum(axis=0)).max()) >= 1 << 24:
+        return None
+    s16 = [
+        int(255 * f8i[:, 2 * j].sum() + 256 * 255 * f8i[:, 2 * j + 1].sum())
+        for j in range(L)
+    ]
+    colb = [(b - 1) + s for s in s16]  # + low limb of the input
+    tail = _fold_tail(fs, colb)
+    if tail is None:
+        return None
+    n_split, shift_e, qtable, np_limbs, c = tail
     return LinearReduceSpec(
         fold8=fold8,
+        c_limbs=int_to_limbs(c, L),
+        n_split=n_split,
+        shift_e=shift_e,
+        qtable=qtable,
+        np_limbs=np_limbs,
+    )
+
+
+def _build_mulred(fs: FieldSpec) -> MulReduceSpec | None:
+    """Derive and *prove* the fused multiply-reduce constants.
+
+    The device algorithm (fields.device._mul_gemm / ops.pallas_mxu) is
+    replayed over exact per-column integer upper bounds.  The input is
+    the UNNORMALIZED schoolbook product column vector of two canonical
+    elements: column P_c accumulates at most ``n_lo(c) + n_lo(c-1)``
+    terms of < 2**16 (lo/hi halves of the 16x16 partial products), so
+    P_c < 2**22 for L <= 24 — exactly the bound that makes the one-hot
+    f32 product contraction exact.  Skipping the 2L-limb carry
+    normalize means the fold digits are the three bytes of each high
+    column (plus P_{L-1}'s 16-bit spill), against residues
+    2**(16c + 8t) mod p, instead of linred's two bytes per limb.
+    """
+    L, p, b = fs.limbs, fs.modulus, 1 << LIMB_BITS
+
+    # exact column caps of the unnormalized schoolbook product
+    def n_lo(c: int) -> int:
+        if c < 0 or c > 2 * L - 2:
+            return 0
+        return L - abs(c - (L - 1))
+
+    pcap = [(n_lo(c) + n_lo(c - 1)) * (b - 1) for c in range(2 * L)]
+    if max(pcap) >= 1 << 24:  # f32-exactness of the product contraction
+        return None
+
+    # digit caps and residues, in the MulReduceSpec digit order
+    d_caps: list[int] = []
+    residues: list[int] = []
+    for t in range(3):
+        for c in range(L, 2 * L):
+            d_caps.append(min(0xFF, pcap[c] >> (8 * t)))
+            residues.append((1 << (LIMB_BITS * c + 8 * t)) % p)
+    d_caps.append(pcap[L - 1] >> LIMB_BITS)
+    residues.append((1 << (LIMB_BITS * L)) % p)
+
+    foldm = np.zeros((3 * L + 1, 2 * L), np.float32)
+    for i, r in enumerate(residues):
+        for m in range(2 * L):
+            foldm[i, m] = (r >> (8 * m)) & 0xFF
+    fmi = foldm.astype(np.int64)
+    caps = np.array(d_caps, np.int64)
+    # exact-float32 guard on the fold contraction's column sums
+    if int((caps[:, None] * fmi).sum(axis=0).max()) >= 1 << 24:
+        return None
+    s16 = [
+        int((caps * fmi[:, 2 * j]).sum() + 256 * (caps * fmi[:, 2 * j + 1]).sum())
+        for j in range(L)
+    ]
+    # kept low part: full columns P_j for j < L-1, P_{L-1} mod 2**16
+    keep = [pcap[j] for j in range(L - 1)] + [b - 1]
+    colb = [k + s for k, s in zip(keep, s16)]
+    tail = _fold_tail(fs, colb)
+    if tail is None:
+        return None
+    n_split, shift_e, qtable, np_limbs, c = tail
+    return MulReduceSpec(
+        foldm=foldm,
         c_limbs=int_to_limbs(c, L),
         n_split=n_split,
         shift_e=shift_e,

@@ -1192,32 +1192,21 @@ def msm_pippenger(
     return _msm_pippenger_core(cs, scalars, points, nbits)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3))
-def _msm_pippenger_core(
-    cs: CurveSpec, scalars: jax.Array, points: jax.Array, nbits: int
+def _bucket_scan(
+    cs: CurveSpec, points: jax.Array, digits: jax.Array, entries: int
 ) -> jax.Array:
-    """Three passes, all batched over the leading axes and all windows at
-    once (the m axis is the only sequential dimension that grows with
-    the problem):
+    """The XLA scatter leg of Pippenger: scan over the m points; each
+    step gathers the point's current bucket per window (take_along_axis
+    over the bucket axis), adds through the complete formulas, and
+    writes it back with a branchless one-hot select.  The per-step
+    ``(…, nw, entries)`` one-hot and whole-bucket-tensor select are the
+    HBM cost the Pallas kernel leg eliminates.
 
-    1. scatter — scan over the m points; each step gathers the point's
-       current bucket per window (take_along_axis over the bucket axis),
-       adds through the complete formulas, and writes it back with a
-       branchless one-hot select.  Digit-0 contributions land in bucket
-       0, which the reduction ignores (identity-safe).
-    2. bucket close — descending suffix-sum scan over the 2**c - 1
-       non-zero buckets: run += B_b; tot += run computes
-       Σ_b b·B_b in 2 adds per bucket, for every window in parallel.
-    3. window combine — MSB-first Horner over the NW window sums via
-       :func:`window_step` (c doublings + 1 add per window).
+    points (..., m, C, L), digits (..., m, nw) ->
+    buckets (..., nw, entries, C, L).
     """
-    m = points.shape[-3]
     batch = points.shape[:-3]
-    window = pippenger_window(m, cs.name)
-    entries = 1 << window
-    nw = min(_n_windows(cs, window), -(-nbits // window))
-    digits = scalar_windows(cs, scalars, window)[..., :nw]  # (..., m, nw)
-
+    nw = digits.shape[-1]
     pts_m = jnp.moveaxis(points, -3, 0)  # (m, ..., C, L)
     digs_m = jnp.moveaxis(digits, -2, 0).astype(jnp.int32)  # (m, ..., nw)
     bucket_ids = jnp.arange(entries, dtype=jnp.int32)
@@ -1233,6 +1222,45 @@ def _msm_pippenger_core(
 
     init_b = identity(cs, batch + (nw, entries))
     buckets, _ = lax.scan(scatter, init_b, (pts_m, digs_m))
+    return buckets
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def _msm_pippenger_core(
+    cs: CurveSpec, scalars: jax.Array, points: jax.Array, nbits: int
+) -> jax.Array:
+    """Three passes, all batched over the leading axes and all windows at
+    once (the m axis is the only sequential dimension that grows with
+    the problem):
+
+    1. scatter — the Pallas bucket-accumulate kernel when the fused
+       tier is active (ops/pallas_mxu.bucket_accumulate: buckets stay
+       VMEM-resident, indexed read-modify-write per point, no
+       materialized one-hot); otherwise the XLA scan leg
+       (:func:`_bucket_scan`).  Both produce bit-identical bucket
+       tensors — same add order through the same complete formulas.
+       Digit-0 contributions land in bucket 0, which the reduction
+       ignores (identity-safe).
+    2. bucket close — descending suffix-sum scan over the 2**c - 1
+       non-zero buckets: run += B_b; tot += run computes
+       Σ_b b·B_b in 2 adds per bucket, for every window in parallel.
+    3. window combine — MSB-first Horner over the NW window sums via
+       :func:`window_step` (c doublings + 1 add per window).
+    """
+    m = points.shape[-3]
+    batch = points.shape[:-3]
+    window = pippenger_window(m, cs.name)
+    entries = 1 << window
+    nw = min(_n_windows(cs, window), -(-nbits // window))
+    digits = scalar_windows(cs, scalars, window)[..., :nw]  # (..., m, nw)
+
+    buckets = None
+    if fused_kernels_active():
+        from ..ops import pallas_mxu
+
+        buckets = pallas_mxu.bucket_accumulate(cs, points, digits, window, nw)
+    if buckets is None:  # fused tier off, or Pallas unavailable
+        buckets = _bucket_scan(cs, points, digits, entries)
 
     # descending suffix sums over buckets [entries-1 .. 1]
     nonzero = jnp.moveaxis(buckets[..., 1:, :, :], -3, 0)[::-1]

@@ -27,6 +27,7 @@ halves, Barrett remainder < 3p fixed by two conditional subtractions.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 
 import jax
@@ -34,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..fields.spec import FieldSpec
+from ..utils import metrics
 
 BLOCK = 128  # lane width: one VPU register row of batch elements
 
@@ -96,13 +98,106 @@ def _cond_sub(rows_x, const_limbs):
     return [jnp.where(keep, xi, di) for xi, di in zip(rows_x, diff)]
 
 
+def rows_mul_dispatch(fs: FieldSpec, interpret: bool = False) -> str:
+    """Which multiply core the fused kernels chain: ``"mxu"`` (the
+    fused multiply-reduce of ops/pallas_mxu.py, schoolbook columns
+    folded through one exact f32 matmul) or ``"barrett"`` (the VPU
+    schoolbook + Barrett core below).  Keyed on the same DKG_TPU_MUL
+    knob as the XLA-leg dispatch (fields.device.mul_dispatch_mode), but
+    in-kernel ``auto`` prefers the MXU core wherever the field admits
+    ``fs.mulred`` — inside a kernel the operands are already
+    VMEM-resident rows, so the matmul fold wins on exactly the backend
+    (Mosaic) where the XLA auto rule keeps classic.  Exception:
+    ``auto`` under INTERPRET mode keeps Barrett — the one-hot gather
+    matmuls make the interpret lowering of multi-multiply kernels
+    pathologically slow to XLA-compile on CPU (minutes for one point
+    add); DKG_TPU_MUL=gemm still forces the MXU core there, which is
+    how the slow-tier parity tests cover it.  Both cores are bit-exact;
+    resolved at kernel trace time."""
+    from ..utils import envknobs
+
+    env = envknobs.choice(
+        "DKG_TPU_MUL",
+        ("auto", "gemm", "classic"),
+        "fd.mul formulation: fused GEMM multiply-reduce vs classic",
+    )
+    if env == "classic":
+        return "barrett"
+    if env == "gemm":
+        if fs.mulred is None:
+            raise ValueError(f"{fs.name} does not admit the fused MXU mul")
+        return "mxu"
+    if fs.mulred is None or interpret:
+        return "barrett"
+    return "mxu"
+
+
+#: trace-time stack of (fs, foldm_t, q2) loaded from kernel operands —
+#: kernel tracing is synchronous, so a plain list is safe
+_MXU_CONSTS: list = []
+
+
+@contextlib.contextmanager
+def rows_mul_context(fs: FieldSpec, const_refs):
+    """Trace-time context: inside the block, ``mod_mul_rows`` for
+    ``fs`` routes through the MXU fused core of ops/pallas_mxu.py.
+
+    ``const_refs`` are the two kernel operand refs appended by
+    :func:`mxu_operands` (empty when the Barrett core is selected —
+    then this is a no-op).  Pallas kernels cannot capture array
+    constants, so the fold matrices must flow in as operands and down
+    to every chained multiply; this context threads them through the
+    point-op row helpers without widening every signature.
+    """
+    if not const_refs:
+        yield
+        return
+    fm_ref, q2_ref = const_refs
+    _MXU_CONSTS.append((fs, fm_ref[...], q2_ref[...]))
+    try:
+        yield
+    finally:
+        _MXU_CONSTS.pop()
+
+
+def mxu_operands(fs: FieldSpec, interpret: bool = False):
+    """(arrays, BlockSpecs) a kernel builder appends to its operands to
+    enable the MXU multiply core for ``fs`` — both empty when
+    :func:`rows_mul_dispatch` selects the Barrett core, so call sites
+    can splat them unconditionally."""
+    if not HAVE_PALLAS or rows_mul_dispatch(fs, interpret) != "mxu":
+        return [], []
+    from .pallas_mxu import mxu_const_arrays
+
+    fm_np, q2_np = mxu_const_arrays(fs)
+    specs = [
+        pl.BlockSpec(fm_np.shape, lambda i: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec(q2_np.shape, lambda i: (0, 0), memory_space=pltpu.VMEM),
+    ]
+    return [jnp.asarray(fm_np), jnp.asarray(q2_np)], specs
+
+
 def mod_mul_rows(fs: FieldSpec, rows_a, rows_b):
     """Modular multiply on unrolled limb-row lists: L tiles in, L out.
 
     The reusable core of the kernel — the fused point-op kernels
     (ops/pallas_point.py) chain many of these without leaving VMEM.
-    Barrett (HAC 14.42), base 2**16 — mirrors fields/device.py.
+    Routes through the MXU fused multiply-reduce core when the
+    enclosing kernel provided the fold matrices via
+    :func:`rows_mul_context`; the Barrett VPU core otherwise.
     """
+    for cfs, foldm_t, q2 in reversed(_MXU_CONSTS):
+        if cfs is fs:
+            from .pallas_mxu import mxu_mul_rows
+
+            return mxu_mul_rows(fs, rows_a, rows_b, foldm_t=foldm_t, q2=q2)
+    return _barrett_mul_rows(fs, rows_a, rows_b)
+
+
+def _barrett_mul_rows(fs: FieldSpec, rows_a, rows_b):
+    """The VPU Barrett multiply core (HAC 14.42), base 2**16 — mirrors
+    fields/device.py.  The fallback for fields without ``fs.mulred``
+    and the DKG_TPU_MUL=classic leg."""
     L = fs.limbs
     mu = [int(v) for v in fs.barrett_mu]  # (L+1,) Python ints
     p_ext = [int(v) for v in fs.p_limbs_ext]  # (L+1,)
@@ -155,10 +250,12 @@ def mod_sub_rows(fs: FieldSpec, rows_a, rows_b):
 def _make_kernel(fs: FieldSpec):
     L = fs.limbs
 
-    def kernel(a_ref, b_ref, out_ref):
+    def kernel(a_ref, b_ref, *rest):
+        out_ref = rest[-1]
         rows_a = [a_ref[i : i + 1, :] for i in range(L)]
         rows_b = [b_ref[i : i + 1, :] for i in range(L)]
-        r = mod_mul_rows(fs, rows_a, rows_b)
+        with rows_mul_context(fs, rest[:-1]):
+            r = mod_mul_rows(fs, rows_a, rows_b)
         for i in range(L):
             out_ref[i : i + 1, :] = r[i]
 
@@ -169,27 +266,31 @@ def _make_kernel(fs: FieldSpec):
 def _mod_mul_tiles(fs: FieldSpec, a_t: jax.Array, b_t: jax.Array, interpret: bool):
     """(L, B) x (L, B) -> (L, B), B a multiple of BLOCK."""
     L, B = a_t.shape
+    extra, extra_specs = mxu_operands(fs, interpret)
     return pl.pallas_call(
         _make_kernel(fs),
         grid=(B // BLOCK,),
         in_specs=[
             pl.BlockSpec((L, BLOCK), lambda i: (0, i), memory_space=pltpu.VMEM),
             pl.BlockSpec((L, BLOCK), lambda i: (0, i), memory_space=pltpu.VMEM),
-        ],
+        ]
+        + extra_specs,
         out_specs=pl.BlockSpec((L, BLOCK), lambda i: (0, i), memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((L, B), jnp.uint32),
         interpret=interpret,
-    )(a_t, b_t)
+    )(a_t, b_t, *extra)
 
 
 def _make_madd_kernel(fs: FieldSpec):
     L = fs.limbs
 
-    def kernel(a_ref, b_ref, c_ref, out_ref):
+    def kernel(a_ref, b_ref, c_ref, *rest):
+        out_ref = rest[-1]
         rows_a = [a_ref[i : i + 1, :] for i in range(L)]
         rows_b = [b_ref[i : i + 1, :] for i in range(L)]
         rows_c = [c_ref[i : i + 1, :] for i in range(L)]
-        r = mod_add_rows(fs, mod_mul_rows(fs, rows_a, rows_b), rows_c)
+        with rows_mul_context(fs, rest[:-1]):
+            r = mod_add_rows(fs, mod_mul_rows(fs, rows_a, rows_b), rows_c)
         for i in range(L):
             out_ref[i : i + 1, :] = r[i]
 
@@ -201,14 +302,15 @@ def _mod_madd_tiles(fs: FieldSpec, a_t, b_t, c_t, interpret: bool):
     """(L, B) x3 -> (L, B): (a*b + c) mod p, one fused launch."""
     L, B = a_t.shape
     spec = pl.BlockSpec((L, BLOCK), lambda i: (0, i), memory_space=pltpu.VMEM)
+    extra, extra_specs = mxu_operands(fs, interpret)
     return pl.pallas_call(
         _make_madd_kernel(fs),
         grid=(B // BLOCK,),
-        in_specs=[spec, spec, spec],
+        in_specs=[spec, spec, spec] + extra_specs,
         out_specs=spec,
         out_shape=jax.ShapeDtypeStruct((L, B), jnp.uint32),
         interpret=interpret,
-    )(a_t, b_t, c_t)
+    )(a_t, b_t, c_t, *extra)
 
 
 def _want_interpret() -> bool:
@@ -229,6 +331,7 @@ def mod_mul(fs: FieldSpec, a: jax.Array, b: jax.Array, *, interpret: bool | None
         from ..fields import device as fd
 
         return fd.mul(fs, a, b)
+    metrics.REGISTRY.inc("pallas_calls_total", kernel="mod_mul")
     a = jnp.asarray(a, jnp.uint32)
     b = jnp.asarray(b, jnp.uint32)
     a, b = jnp.broadcast_arrays(a, b)
@@ -267,6 +370,7 @@ def mod_madd(
         from ..fields import device as fd
 
         return fd.add(fs, fd.mul(fs, a, b), c)
+    metrics.REGISTRY.inc("pallas_calls_total", kernel="mod_madd")
     a, b, c = jnp.broadcast_arrays(
         jnp.asarray(a, jnp.uint32), jnp.asarray(b, jnp.uint32), jnp.asarray(c, jnp.uint32)
     )

@@ -27,7 +27,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..groups.device import CurveSpec
-from .pallas_field import BLOCK, mod_add_rows, mod_mul_rows, mod_sub_rows
+from ..utils import metrics
+from .pallas_field import (
+    BLOCK,
+    mod_add_rows,
+    mod_mul_rows,
+    mod_sub_rows,
+    mxu_operands,
+    rows_mul_context,
+)
 
 try:
     from jax.experimental import pallas as pl
@@ -250,64 +258,70 @@ def _point_spec(cs: CurveSpec):
 def _add_call(cs: CurveSpec, p_t: jax.Array, q_t: jax.Array, interpret: bool):
     L, C = cs.field.limbs, cs.ncoords
 
-    def kernel(p_ref, q_ref, out_ref):
-        _rows_out(
-            out_ref, _add_rows(cs, _rows_in(p_ref, L, C), _rows_in(q_ref, L, C)), L
-        )
+    def kernel(p_ref, q_ref, *rest):
+        with rows_mul_context(cs.field, rest[:-1]):
+            _rows_out(
+                rest[-1], _add_rows(cs, _rows_in(p_ref, L, C), _rows_in(q_ref, L, C)), L
+            )
 
     B = p_t.shape[-1]
     spec = _point_spec(cs)
+    extra, extra_specs = mxu_operands(cs.field, interpret)
     return pl.pallas_call(
         kernel,
         grid=(B // BLOCK,),
-        in_specs=[spec, spec],
+        in_specs=[spec, spec] + extra_specs,
         out_specs=spec,
         out_shape=jax.ShapeDtypeStruct((C * L, B), jnp.uint32),
         interpret=interpret,
-    )(p_t, q_t)
+    )(p_t, q_t, *extra)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 3))
 def _madd_call(cs: CurveSpec, p_t: jax.Array, q_t: jax.Array, interpret: bool):
     L, C = cs.field.limbs, cs.ncoords
 
-    def kernel(p_ref, q_ref, out_ref):
-        _rows_out(
-            out_ref, _madd_rows(cs, _rows_in(p_ref, L, C), _rows_in(q_ref, L, C)), L
-        )
+    def kernel(p_ref, q_ref, *rest):
+        with rows_mul_context(cs.field, rest[:-1]):
+            _rows_out(
+                rest[-1], _madd_rows(cs, _rows_in(p_ref, L, C), _rows_in(q_ref, L, C)), L
+            )
 
     B = p_t.shape[-1]
     spec = _point_spec(cs)
+    extra, extra_specs = mxu_operands(cs.field, interpret)
     return pl.pallas_call(
         kernel,
         grid=(B // BLOCK,),
-        in_specs=[spec, spec],
+        in_specs=[spec, spec] + extra_specs,
         out_specs=spec,
         out_shape=jax.ShapeDtypeStruct((C * L, B), jnp.uint32),
         interpret=interpret,
-    )(p_t, q_t)
+    )(p_t, q_t, *extra)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 2, 3))
 def _double_call(cs: CurveSpec, p_t: jax.Array, n_doubles: int, interpret: bool):
     L, C = cs.field.limbs, cs.ncoords
 
-    def kernel(p_ref, out_ref):
-        rows = _rows_in(p_ref, L, C)
-        for _ in range(n_doubles):
-            rows = _double_rows(cs, rows)
-        _rows_out(out_ref, rows, L)
+    def kernel(p_ref, *rest):
+        with rows_mul_context(cs.field, rest[:-1]):
+            rows = _rows_in(p_ref, L, C)
+            for _ in range(n_doubles):
+                rows = _double_rows(cs, rows)
+            _rows_out(rest[-1], rows, L)
 
     B = p_t.shape[-1]
     spec = _point_spec(cs)
+    extra, extra_specs = mxu_operands(cs.field, interpret)
     return pl.pallas_call(
         kernel,
         grid=(B // BLOCK,),
-        in_specs=[spec],
+        in_specs=[spec] + extra_specs,
         out_specs=spec,
         out_shape=jax.ShapeDtypeStruct((C * L, B), jnp.uint32),
         interpret=interpret,
-    )(p_t)
+    )(p_t, *extra)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 2, 3))
@@ -317,23 +331,25 @@ def _window_call(cs: CurveSpec, acc_t: jax.Array, n_doubles: int, interpret: boo
     scalar_mul's scan body (groups/device.py _scalar_mul_core)."""
     L, C = cs.field.limbs, cs.ncoords
 
-    def kernel(acc_ref, entry_ref, out_ref):
-        rows = _rows_in(acc_ref, L, C)
-        for _ in range(n_doubles):
-            rows = _double_rows(cs, rows)
-        rows = _add_rows(cs, rows, _rows_in(entry_ref, L, C))
-        _rows_out(out_ref, rows, L)
+    def kernel(acc_ref, entry_ref, *rest):
+        with rows_mul_context(cs.field, rest[:-1]):
+            rows = _rows_in(acc_ref, L, C)
+            for _ in range(n_doubles):
+                rows = _double_rows(cs, rows)
+            rows = _add_rows(cs, rows, _rows_in(entry_ref, L, C))
+            _rows_out(rest[-1], rows, L)
 
     B = acc_t.shape[-1]
     spec = _point_spec(cs)
+    extra, extra_specs = mxu_operands(cs.field, interpret)
     return pl.pallas_call(
         kernel,
         grid=(B // BLOCK,),
-        in_specs=[spec, spec],
+        in_specs=[spec, spec] + extra_specs,
         out_specs=spec,
         out_shape=jax.ShapeDtypeStruct((C * L, B), jnp.uint32),
         interpret=interpret,
-    )(acc_t, entry_t)
+    )(acc_t, entry_t, *extra)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 3, 4))
@@ -354,7 +370,7 @@ def _ladder_call(
     """
     L, C = cs.field.limbs, cs.ncoords
 
-    def kernel(p_ref, add_ref, bits_ref, out_ref):
+    def kernel(p_ref, add_ref, bits_ref, *rest):
         p_rows = _rows_in(p_ref, L, C)
 
         def body(i, m_arr):
@@ -372,28 +388,30 @@ def _ladder_call(
         m_arr = jnp.concatenate(
             [r for coord in _identity_rows(cs, p_ref[0:1, :]) for r in coord], axis=0
         )
-        if interpret:
-            # interpret-mode lowering of fori_loop over this body is
-            # pathologically slow to compile; tests use tiny nbits, so
-            # unroll instead.
-            for i in range(nbits):
-                m_arr = body(i, m_arr)
-        else:
-            m_arr = jax.lax.fori_loop(0, nbits, body, m_arr)
-        rows = _add_rows(cs, _rows_in(m_arr, L, C), _rows_in(add_ref, L, C))
-        _rows_out(out_ref, rows, L)
+        with rows_mul_context(cs.field, rest[:-1]):
+            if interpret:
+                # interpret-mode lowering of fori_loop over this body is
+                # pathologically slow to compile; tests use tiny nbits, so
+                # unroll instead.
+                for i in range(nbits):
+                    m_arr = body(i, m_arr)
+            else:
+                m_arr = jax.lax.fori_loop(0, nbits, body, m_arr)
+            rows = _add_rows(cs, _rows_in(m_arr, L, C), _rows_in(add_ref, L, C))
+        _rows_out(rest[-1], rows, L)
 
     B = p_t.shape[-1]
     spec = _point_spec(cs)
     bits_spec = pl.BlockSpec((nbits, BLOCK), lambda i: (0, i), memory_space=pltpu.VMEM)
+    extra, extra_specs = mxu_operands(cs.field, interpret)
     return pl.pallas_call(
         kernel,
         grid=(B // BLOCK,),
-        in_specs=[spec, spec, bits_spec],
+        in_specs=[spec, spec, bits_spec] + extra_specs,
         out_specs=spec,
         out_shape=jax.ShapeDtypeStruct((C * L, B), jnp.uint32),
         interpret=interpret,
-    )(p_t, add_t, bits_t)
+    )(p_t, add_t, bits_t, *extra)
 
 
 def _to_tiles(cs: CurveSpec, pts: jax.Array) -> tuple[jax.Array, tuple, int]:
@@ -436,6 +454,7 @@ def pt_add(cs: CurveSpec, p: jax.Array, q: jax.Array, *, interpret: bool | None 
         from ..groups import device as gd
 
         return gd._add_xla(cs, p, q)
+    metrics.REGISTRY.inc("pallas_calls_total", kernel="pt_add")
     p, q = jnp.broadcast_arrays(jnp.asarray(p, jnp.uint32), jnp.asarray(q, jnp.uint32))
     p_t, batch, n = _to_tiles(cs, p)
     q_t, _, _ = _to_tiles(cs, q)
@@ -450,6 +469,7 @@ def pt_madd(cs: CurveSpec, p: jax.Array, q: jax.Array, *, interpret: bool | None
         from ..groups import device as gd
 
         return gd._madd_xla(cs, p, q)
+    metrics.REGISTRY.inc("pallas_calls_total", kernel="pt_madd")
     p, q = jnp.broadcast_arrays(jnp.asarray(p, jnp.uint32), jnp.asarray(q, jnp.uint32))
     p_t, batch, n = _to_tiles(cs, p)
     q_t, _, _ = _to_tiles(cs, q)
@@ -465,6 +485,7 @@ def pt_double(cs: CurveSpec, p: jax.Array, n_doubles: int = 1, *, interpret: boo
         for _ in range(n_doubles):
             p = gd._double_xla(cs, p)
         return p
+    metrics.REGISTRY.inc("pallas_calls_total", kernel="pt_double")
     p = jnp.asarray(p, jnp.uint32)
     p_t, batch, n = _to_tiles(cs, p)
     out = _double_call(cs, p_t, n_doubles, _interp() if interpret is None else interpret)
@@ -481,6 +502,7 @@ def pt_window_step(
         for _ in range(n_doubles):
             acc = gd._double_xla(cs, acc)
         return gd._add_xla(cs, acc, entry)
+    metrics.REGISTRY.inc("pallas_calls_total", kernel="pt_window_step")
     acc, entry = jnp.broadcast_arrays(
         jnp.asarray(acc, jnp.uint32), jnp.asarray(entry, jnp.uint32)
     )
@@ -521,6 +543,7 @@ def pt_ladder_mul_add(
                 bits[..., i] != 0, gd._add_xla(cs, acc, p), acc
             )
         return gd._add_xla(cs, acc, addend)
+    metrics.REGISTRY.inc("pallas_calls_total", kernel="pt_ladder_mul_add")
     p, addend = jnp.broadcast_arrays(
         jnp.asarray(p, jnp.uint32), jnp.asarray(addend, jnp.uint32)
     )

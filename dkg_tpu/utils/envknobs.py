@@ -11,7 +11,10 @@ DKG_TPU_ED_FUSED_LADDER / DKG_TPU_ED_FUSED_DOUBLES via groups.device,
 DKG_TPU_PALLAS / DKG_TPU_ASSUME_BACKEND / DKG_TPU_REDUCE
 (fold|linear|barrett — force a wide-reduction algorithm; inadmissible
 choices raise at trace time) / DKG_TPU_CARRY (scan|lookahead carry
-propagation in normalize) via fields.device,
+propagation in normalize) / DKG_TPU_MUL (auto|gemm|classic — the
+fd.mul formulation: fused GEMM multiply-reduce twin vs
+mul_wide+reduce_wide; gemm raises at trace time on fields that fail
+the spec.mulred admission proofs) via fields.device,
 DKG_TPU_MXU via fields.matmul, DKG_TPU_TABLE_CACHE via
 groups.precompute, DKG_TPU_NET_* transport knobs via net.channel,
 DKG_TPU_SIGN_BATCH (device message-chunk size) and

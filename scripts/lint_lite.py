@@ -116,6 +116,13 @@ linter), so the committed baseline stays clean between CI runs:
         (curve, quorum) / (ceremony, epoch)), because SIGN_r01 measured
         exactly this re-derivation dominating steady-state signing
         (docs/signing.md "Steady-state lane")
+* DKG014  (dkg_tpu/ only, dkg_tpu/ops/ exempt) ``pallas_call`` outside
+        the kernel layer: every Pallas program lives in ``dkg_tpu/ops/``
+        behind its dispatch seam (``fused_kernels_active`` and the
+        interpret/Mosaic fallbacks), so a kernel launched from protocol
+        or group code would bypass the backend gating, the
+        ``pallas_calls_total`` accounting, and the bit-exactness test
+        tiers (docs/perf.md "MXU formulation")
 
 Exit 0 = clean.  Run: ``python scripts/lint_lite.py`` (from repo root).
 Also executed by tests/test_import_hygiene.py so the default test tier
@@ -288,6 +295,7 @@ class _Checker(ast.NodeVisitor):
         self._dkg_module = "dkg_tpu/dkg/" in path.as_posix()
         self._pkg_module = "dkg_tpu/" in path.as_posix()
         self._service_module = "dkg_tpu/service/" in path.as_posix()
+        self._ops_module = "dkg_tpu/ops/" in path.as_posix()
         self._epoch_module = "dkg_tpu/epoch/" in path.as_posix()
         self._sign_module = "dkg_tpu/sign/" in path.as_posix()
         self._dem_hot_module = (
@@ -769,6 +777,22 @@ class _Checker(ast.NodeVisitor):
                     "and aggregation run as ONE batched call "
                     "(gd.scalar_mul over the (B, t+1) grid / "
                     "gd.msm_pippenger); *_host oracle legs only",
+                )
+        # DKG014: Pallas programs live in dkg_tpu/ops/ only — a
+        # pallas_call anywhere else bypasses the fused-tier dispatch
+        # seams, the kernel-call accounting, and the parity test tiers.
+        if self._pkg_module and not self._ops_module:
+            func = node.func
+            name = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else ""
+            )
+            if name == "pallas_call":
+                self._add(
+                    node,
+                    "DKG014",
+                    "pallas_call outside dkg_tpu/ops/ — kernels live in "
+                    "the ops layer behind fused_kernels_active and the "
+                    "interpret/Mosaic dispatch seams",
                 )
         # DKG004b: a hashlib.blake2b call lexically inside a loop in a
         # batch hot module is a per-dealer host hash loop — use

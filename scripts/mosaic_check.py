@@ -2,7 +2,9 @@
 """Standalone Mosaic lowering check — run FIRST on a live TPU.
 
 Compiles and executes the fused Pallas kernels (mod_mul, mod_madd,
-pt_add, pt_window_step, pt_ladder_mul_add) at the smallest real shapes
+pt_add, pt_window_step, pt_ladder_mul_add, plus the MXU tier's
+mxu_mod_mul fused multiply-reduce and the Pippenger bucket_accumulate
+scatter kernel) at the smallest real shapes
 on the chip, BEFORE any bench rung touches them — so a BlockSpec/layout
 rejection or a pathological Mosaic compile surfaces as a five-minute
 diagnosis instead of a lost bench run (the round-3 48-minute silent
@@ -48,6 +50,7 @@ from dkg_tpu.fields import host as fh  # noqa: E402
 from dkg_tpu.groups import device as gd  # noqa: E402
 from dkg_tpu.groups import host as gh  # noqa: E402
 from dkg_tpu.ops import pallas_field as pf  # noqa: E402
+from dkg_tpu.ops import pallas_mxu as pm  # noqa: E402
 from dkg_tpu.ops import pallas_point as pp  # noqa: E402
 
 CURVE = sys.argv[1] if len(sys.argv) > 1 else "secp256k1"
@@ -146,12 +149,39 @@ def main() -> int:
         ]
         return got == want
 
+    def chk_mxu_mul():
+        # the MXU-native fused multiply-reduce (ops/pallas_mxu.py) —
+        # one f32 GEMM fold + lazy carry, vs the int-level oracle
+        out = pm.mxu_mod_mul(fs, xl, yl, interpret=False)
+        sync(out)
+        got = [int(v) for v in fh.decode(fs, np.asarray(out))]
+        return got == [x * y % fs.modulus for x, y in zip(xs, ys)]
+
+    def chk_bucket():
+        # Pippenger scatter pass with VMEM-resident buckets, vs the XLA
+        # scan leg bit-for-bit; m=20 exercises the sentinel-digit
+        # padding (m rounds up to a BLOCK multiple on Mosaic)
+        m, window, nw = 20, 4, 4
+        entries = 1 << window
+        bp_host = [group.scalar_mul(rng.randrange(1, 100), g) for _ in range(m)]
+        bp_dev = gd.from_host(cs, bp_host)
+        digs = jnp.asarray(
+            [[rng.randrange(entries) for _ in range(nw)] for _ in range(m)],
+            jnp.int32,
+        )
+        out = pm.bucket_accumulate(cs, bp_dev, digs, window, nw, interpret=False)
+        sync(out)
+        want = gd._bucket_scan(cs, bp_dev, digs, entries)
+        return bool(jnp.all(out == want))
+
     results = [
         step("mod_mul", chk_mul),
         step("mod_madd", chk_madd),
         step("pt_add", chk_add),
         step("pt_window_step", chk_window),
         step("pt_ladder_mul_add", chk_ladder),
+        step("mxu_mod_mul", chk_mxu_mul),
+        step("bucket_accumulate", chk_bucket),
     ]
     ok = all(results)
     print(json.dumps({"mosaic_check": "pass" if ok else "fail"}), flush=True)

@@ -25,6 +25,11 @@ Deliberately forgiving about everything except a real regression:
   durable WAL journaling armed, the other without) are likewise
   incomparable -> exit 0 with a note: fsync'd checkpointing is a
   deliberate durability cost, not a perf regression;
+* different kernel tiers (``config.pallas_ceremony``, falling back to
+  the plain ``config.pallas`` flag on older rounds; same rule per
+  round for the SIGN history's ``pallas`` field) are incomparable ->
+  exit 0 with a note: an interpret-mode Pallas round on CPU and an
+  XLA round execute entirely different programs;
 * improvements and <=20% noise -> exit 0;
 * the ``metrics`` block (process-wide registry snapshot embedded by
   bench.py since the observability PR) is tolerated and passed through
@@ -153,6 +158,24 @@ def main(argv: list[str] | None = None) -> int:
         print(
             f"perf_regress: r{old_n} (checkpoint={old_ckpt}) vs r{new_n} "
             f"(checkpoint={new_ckpt}) measured different durability modes "
+            "— incomparable, skipping"
+        )
+        return fleet_bad
+
+    # which kernel tier did the measured ceremony run?  ``pallas_ceremony``
+    # (the fused-kernel flag as the bench child saw it) with the older
+    # rounds' plain ``pallas`` flag as the fallback key — a cpu
+    # interpret-mode Pallas round and an XLA round execute entirely
+    # different programs, so diffing them says nothing about either.
+    def _pallas_mode(parsed: dict) -> bool:
+        cfg = parsed.get("config") or {}
+        return bool(cfg.get("pallas_ceremony", cfg.get("pallas")))
+
+    old_pal, new_pal = _pallas_mode(old), _pallas_mode(new)
+    if old_pal != new_pal:
+        print(
+            f"perf_regress: r{old_n} (pallas={old_pal}) vs r{new_n} "
+            f"(pallas={new_pal}) measured different kernel tiers "
             "— incomparable, skipping"
         )
         return fleet_bad
@@ -515,6 +538,13 @@ def sign_gate(root: pathlib.Path, threshold: float) -> int:
             f"perf_regress: sign r{old_n} ({old.get('platform')}) vs "
             f"r{new_n} ({new.get('platform')}) ran on different platforms "
             "— incomparable, skipping"
+        )
+        return 0
+    if bool(old.get("pallas")) != bool(new.get("pallas")):
+        print(
+            f"perf_regress: sign r{old_n} (pallas={bool(old.get('pallas'))}) "
+            f"vs r{new_n} (pallas={bool(new.get('pallas'))}) measured "
+            "different kernel tiers — incomparable, skipping"
         )
         return 0
 

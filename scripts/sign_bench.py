@@ -62,6 +62,7 @@ if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
     )
 
 from dkg_tpu import sign as signing  # noqa: E402
+from dkg_tpu.groups import device as gd  # noqa: E402
 from dkg_tpu.groups import host as gh  # noqa: E402
 from dkg_tpu.utils import runtimeobs  # noqa: E402
 from dkg_tpu.utils.metrics import REGISTRY  # noqa: E402
@@ -328,6 +329,10 @@ def main(argv=None) -> int:
     report = {
         "bench": "sign",
         "platform": jax.default_backend(),
+        # kernel tier the measured programs traced with — perf_regress
+        # refuses to diff rounds across a fused/XLA flip (different
+        # programs, not a regression)
+        "pallas": bool(gd.fused_kernels_active()),
         "nproc": os.cpu_count(),
         "messages": args.messages,
         "seed": args.seed,
