@@ -246,21 +246,39 @@ def _north_star_child(n_ns: int, t_ns: int) -> None:
     )
 
 
-def north_star_rung():
+def north_star_rung(platform: str = "tpu"):
     """Whole-ceremony wall-clock at the north-star shape (BASELINE.json:
-    secp256k1, n=4096, t=1365, <10 s on a v5e-8 => 80 s single-chip
-    budget at the mesh layout's linear party-axis scaling).
+    secp256k1, n=4096, t=1365, <10 s on a v5e-8), measured on the
+    SHARDED path: each attempt routes through scripts/northstar_bench.py
+    (run_sharded_ceremony over a device mesh — the attached accelerator
+    on TPU, a host-count-forced 8-device CPU mesh otherwise, clearly
+    labelled ``platform``), which also writes the NORTHSTAR_r*.json
+    round artifact scripts/perf_regress.py gates.
 
     Each size attempt runs in a subprocess under a HARD timeout (the
     only honest time-box: in-process estimates cannot bound a stalled
-    remote compile).  Smaller n keeps the t=1365 cost structure; the
-    n=4096 extrapolation is reported explicitly.  Returns a dict for
-    the JSON line's ``north_star`` slot.
+    remote compile).  The TPU ladder keeps the t=1365 cost structure;
+    the CPU ladder descends to shapes a 1-core box can execute, with
+    the n=4096 extrapolation and bit-exact-vs-unsharded flag reported
+    explicitly.  Returns a dict for the JSON line's ``north_star`` slot.
     """
-    t_ns = 1365
-    for n_ns, timeout_s in ((4096, 900.0), (2048, 450.0), (1024, 300.0)):
+    if platform == "tpu":
+        ladder = (
+            ("ambient", 4096, 1365, 900.0),
+            ("ambient", 2048, 1365, 450.0),
+            ("ambient", 1024, 1365, 300.0),
+        )
+    else:
+        ladder = (
+            ("cpu", 64, 21, 1500.0),
+            ("cpu", 16, 5, 900.0),
+        )
+    for plat, n_ns, t_ns, timeout_s in ladder:
         res = _child(
-            "import bench; bench._north_star_child(%d, %d)" % (n_ns, t_ns),
+            "import runpy,sys; sys.argv=['northstar_bench.py','--n','%d',"
+            "'--t','%d','--platform','%s']; "
+            "runpy.run_path('scripts/northstar_bench.py', run_name='__main__')"
+            % (n_ns, t_ns, plat),
             timeout_s,
         )
         if res is not None:
@@ -932,9 +950,15 @@ def main():
         # chip twice (see the ladder comment) and burn every retry size.
         os.environ.update(extra_env)
         try:
+            # DKG_TPU_NORTH_STAR=1 forces the sharded north-star attempt
+            # on ANY platform (the artifact labels the platform and the
+            # perf gate skips cross-platform diffs); on TPU it runs by
+            # default unless DKG_TPU_BENCH_NS=0 opts out
             north_star = None
-            if platform == "tpu" and os.environ.get("DKG_TPU_BENCH_NS") != "0":
-                north_star = north_star_rung()
+            if os.environ.get("DKG_TPU_NORTH_STAR") == "1" or (
+                platform == "tpu" and os.environ.get("DKG_TPU_BENCH_NS") != "0"
+            ):
+                north_star = north_star_rung(platform)
             kem = None
             if platform == "tpu" and os.environ.get("DKG_TPU_BENCH_KEM") != "0":
                 kem = kem_rung()

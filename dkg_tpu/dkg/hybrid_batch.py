@@ -247,6 +247,81 @@ def seal_shares_pipeline(
     return out
 
 
+def _mesh_slabs(x, spans):
+    """Per-shard views of a (possibly mesh-sharded) dealer-major array.
+
+    When ``x`` is a jax array actually sharded over the dealer axis the
+    slabs are its resident per-device blocks (``addressable_shards``,
+    ordered by global offset) — fetching one never materialises the
+    whole array on the host.  Host arrays and replicated/single-device
+    layouts fall back to plain slices, so the pipeline below works
+    unchanged in unsharded tests.
+    """
+    import jax as _jax
+
+    per = list(getattr(x, "addressable_shards", ()) or ())
+    if isinstance(x, _jax.Array) and len(per) == len(spans):
+        per.sort(key=lambda sh: sh.index[0].start or 0)
+        starts = [sh.index[0].start or 0 for sh in per]
+        if starts == [a for a, _b in spans]:
+            return [sh.data for sh in per]
+    return [x[a:b] for a, b in spans]
+
+
+def seal_shares_mesh(
+    group: gh.HostGroup,
+    cfg,
+    mesh,
+    shares,  # (n_dealers, n_recipients, L) limbs, mesh-sharded or host
+    hidings,
+    pks_dev: jnp.ndarray,
+    r_enc,  # (n_dealers, n_recipients, L) encryption randomness (host)
+    g_table: jnp.ndarray,
+    chunk: int | None = None,
+) -> list[list[tuple[HybridCiphertext, HybridCiphertext]]]:
+    """:func:`seal_shares_pipeline`'s chunk overlap lifted to mesh
+    shards: the dealer axis is walked shard block by shard block, so
+
+    * the host only ever materialises ONE shard's (n/ndev, n, L) share
+      slab at a time — peak host bytes are O(n^2/ndev), not O(n^2),
+      which is what keeps the n=16384 dealing round inside a host
+      (scripts/memproof_stream.py records the bound);
+    * shard k+1's device->host transfer (``copy_to_host_async``) runs
+      under shard k's host DEM, and within a shard the per-chunk
+      KEM-dispatch-ahead pipeline runs unchanged.
+
+    Shard blocks are independent dealer rows, so output is bit-identical
+    to one ``seal_shares_pipeline`` over the whole round (pinned by
+    tests/test_hybrid_batch.py).
+    """
+    n_dev = int(mesh.devices.size)
+    n_d = r_enc.shape[0]
+    if n_d % n_dev != 0:
+        raise ValueError("dealer count must divide evenly over the mesh")
+    block = n_d // n_dev
+    spans = [(k * block, (k + 1) * block) for k in range(n_dev)]
+    slabs_s = _mesh_slabs(shares, spans)
+    slabs_h = _mesh_slabs(hidings, spans)
+    for t in (slabs_s[0], slabs_h[0]):
+        if hasattr(t, "copy_to_host_async"):
+            t.copy_to_host_async()
+    out: list[list[tuple[HybridCiphertext, HybridCiphertext]]] = []
+    for k, (a, b) in enumerate(spans):
+        if k + 1 < n_dev:
+            # start shard k+1's transfer BEFORE shard k's DEM blocks
+            for t in (slabs_s[k + 1], slabs_h[k + 1]):
+                if hasattr(t, "copy_to_host_async"):
+                    t.copy_to_host_async()
+        out.extend(
+            seal_shares_pipeline(
+                group, cfg,
+                np.asarray(slabs_s[k]), np.asarray(slabs_h[k]),
+                pks_dev, r_enc[a:b], g_table, chunk=chunk,
+            )
+        )
+    return out
+
+
 def open_share(
     group: gh.HostGroup,
     sk: int,

@@ -2,7 +2,7 @@
 
 import importlib
 
-_SUBMODULES = ("hostmesh", "mesh", "multihost")
+_SUBMODULES = ("hostmesh", "mesh", "multihost", "signmesh")
 
 
 # Lazy (PEP 562): `from dkg_tpu.parallel.hostmesh import force_cpu_mesh`

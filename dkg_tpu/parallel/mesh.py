@@ -21,6 +21,8 @@ it to the caller.
 from __future__ import annotations
 
 import functools
+import os
+import time
 
 import jax
 import jax.numpy as jnp
@@ -35,26 +37,24 @@ except ImportError:  # pragma: no cover
 import inspect
 
 # jax renamed shard_map's replication-check kwarg (check_rep -> check_vma
-# in 0.9).  Resolve the right name once so call sites stay stable; fail
-# loudly on a future rename rather than silently re-enabling the check.
+# in 0.9), and newer versions drop it entirely (checked semantics became
+# the only semantics).  Resolve the right name once so call sites stay
+# stable; None means "no kwarg to pass" — every body in this module is
+# collective-explicit, so it type-checks under the always-checked
+# signature and the wrapper degrades to plain shard_map.
 _SHARD_MAP_CHECK_KW = next(
     (k for k in ("check_vma", "check_rep") if k in inspect.signature(_shard_map).parameters),
     None,
 )
-if _SHARD_MAP_CHECK_KW is None:  # pragma: no cover
-    raise RuntimeError(
-        "installed jax's shard_map has neither check_vma nor check_rep; "
-        "update _SHARD_MAP_CHECK_KW in dkg_tpu/parallel/mesh.py for this jax version"
-    )
 
 
 def _shard_map_nocheck(f, *, mesh, in_specs, out_specs):
-    """shard_map with the replication/VMA check disabled (named so a
-    future call site wanting jax's checked semantics doesn't silently
-    get this wrapper)."""
+    """shard_map with the replication/VMA check disabled where the
+    installed jax still exposes one (named so a future call site wanting
+    jax's checked semantics doesn't silently get this wrapper)."""
     return _shard_map(
         f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-        **{_SHARD_MAP_CHECK_KW: False},
+        **({_SHARD_MAP_CHECK_KW: False} if _SHARD_MAP_CHECK_KW else {}),
     )
 
 from ..dkg import ceremony as ce
@@ -62,6 +62,41 @@ from ..groups import device as gd
 from jax import lax
 
 PARTY_AXIS = "parties"
+
+# Env knobs whose values are read at TRACE time and baked into the
+# compiled sharded programs (chunk widths, field mul/reduce/carry
+# formulation, MSM/window schedule, fused tiers, digest dispatch).  The
+# memoized program builders below put a snapshot of these values into
+# their cache key, so flipping a knob between calls retraces — the
+# semantics per-call eager tracing always had — while a steady-state
+# rerun at stable knobs reuses the jitted executable instead of
+# recompiling the whole sharded program set: before this cache the
+# north-star warm run cost the same as the cold one (NORTHSTAR r01
+# measured warm 135.6 s vs cold 126.0 s at (16, 5) on the CPU mesh —
+# pure retrace).
+_TRACE_KNOBS = (
+    "DKG_TPU_DEAL_CHUNK",
+    "DKG_TPU_VERIFY_CHUNK",
+    "DKG_TPU_RLC_CHUNK",
+    "DKG_TPU_MSM",
+    "DKG_TPU_FB_WINDOW",
+    "DKG_TPU_FUSED_MULTI",
+    "DKG_TPU_ED_FUSED_LADDER",
+    "DKG_TPU_ED_FUSED_DOUBLES",
+    "DKG_TPU_PALLAS",
+    "DKG_TPU_ASSUME_BACKEND",
+    "DKG_TPU_REDUCE",
+    "DKG_TPU_CARRY",
+    "DKG_TPU_MUL",
+    "DKG_TPU_MXU",
+    "DKG_TPU_DIGEST",
+)
+
+
+def _knob_state() -> tuple:
+    """Snapshot of the trace-relevant knobs (empty == unset, matching
+    envknobs' convention) — the program builders' cache-key tail."""
+    return tuple(os.environ.get(k) or None for k in _TRACE_KNOBS)
 
 
 def _verify_env_chunk() -> int | None:
@@ -159,7 +194,17 @@ def sharded_deal_commitments(
     in one outer jit — that fuses them back into one program.
     """
     _check_mesh(cfg, mesh)
+    step = _deal_commitments_prog(cfg, mesh, _knob_state())
+    return step(coeffs_a, coeffs_b, g_table, h_table)
 
+
+@functools.lru_cache(maxsize=None)
+def _deal_commitments_prog(cfg: ce.CeremonyConfig, mesh: Mesh, knobs: tuple):
+    """Memoized, jitted round-1 commitment program (``knobs`` is cache
+    key only — the trace below re-reads the environment)."""
+    del knobs
+
+    @jax.jit
     @functools.partial(
         _shard_map_nocheck,
         mesh=mesh,
@@ -172,7 +217,7 @@ def sharded_deal_commitments(
         # one-shot body at BLS n=16384/8 devices was rejected at 21.3 GB
         return ce.deal_commitments_traced_chunked(cfg, ca, cb, gt, ht)
 
-    return step(coeffs_a, coeffs_b, g_table, h_table)
+    return step
 
 
 def sharded_deal_shares(
@@ -184,7 +229,14 @@ def sharded_deal_shares(
     """Round-1 share program: (s, r), dealer-sharded (second of the two
     sequential deal programs; see :func:`sharded_deal_commitments`)."""
     _check_mesh(cfg, mesh)
+    return _deal_shares_prog(cfg, mesh, _knob_state())(coeffs_a, coeffs_b)
 
+
+@functools.lru_cache(maxsize=None)
+def _deal_shares_prog(cfg: ce.CeremonyConfig, mesh: Mesh, knobs: tuple):
+    del knobs
+
+    @jax.jit
     @functools.partial(
         _shard_map_nocheck,
         mesh=mesh,
@@ -194,7 +246,7 @@ def sharded_deal_shares(
     def step(ca, cb):
         return ce.deal_shares_traced_chunked(cfg, ca, cb)
 
-    return step(coeffs_a, coeffs_b)
+    return step
 
 
 def sharded_verify_finalise(
@@ -235,9 +287,20 @@ def sharded_verify_finalise(
     Returns (ok, final_shares, master): ok/final_shares
     recipient-sharded, master replicated.
     """
-    n_dev = _check_mesh(cfg, mesh)
+    _check_mesh(cfg, mesh)
+    step = _verify_finalise_prog(cfg, mesh, rho_bits, _knob_state())
+    return step(a0, e, s, r, g_table, h_table, rho)
+
+
+@functools.lru_cache(maxsize=None)
+def _verify_finalise_prog(
+    cfg: ce.CeremonyConfig, mesh: Mesh, rho_bits: int, knobs: tuple
+):
+    del knobs
+    n_dev = mesh.devices.size
     cs = cfg.cs
 
+    @jax.jit
     @functools.partial(
         _shard_map_nocheck,
         mesh=mesh,
@@ -267,7 +330,7 @@ def sharded_verify_finalise(
         master = _master_shardlocal(cfg, n_dev, a0_sh, qual, shard, block)
         return ok, finals, master
 
-    return step(a0, e, s, r, g_table, h_table, rho)
+    return step
 
 
 def _master_shardlocal(cfg, n_dev, a0_sh, qual, shard, block):
@@ -381,8 +444,16 @@ def sharded_finalise(
     """Aggregation + master key only, over an adjudicated qualified set
     (the blame path re-finalise: no verification work — the pairwise
     checks already determined exactly which dealers are out)."""
-    n_dev = _check_mesh(cfg, mesh)
+    _check_mesh(cfg, mesh)
+    return _finalise_prog(cfg, mesh, _knob_state())(a0, s, qualified)
 
+
+@functools.lru_cache(maxsize=None)
+def _finalise_prog(cfg: ce.CeremonyConfig, mesh: Mesh, knobs: tuple):
+    del knobs
+    n_dev = mesh.devices.size
+
+    @jax.jit
     @functools.partial(
         _shard_map_nocheck,
         mesh=mesh,
@@ -396,7 +467,7 @@ def sharded_finalise(
         master = _master_shardlocal(cfg, n_dev, a0_sh, qual, shard, block)
         return finals, master
 
-    return step(a0, s, qualified)
+    return step
 
 
 def sharded_blame(
@@ -420,7 +491,14 @@ def sharded_blame(
     mults per shard.
     """
     _check_mesh(cfg, mesh)
+    return _blame_prog(cfg, mesh, _knob_state())(e, s, r, g_table, h_table)
 
+
+@functools.lru_cache(maxsize=None)
+def _blame_prog(cfg: ce.CeremonyConfig, mesh: Mesh, knobs: tuple):
+    del knobs
+
+    @jax.jit
     @functools.partial(
         _shard_map_nocheck,
         mesh=mesh,
@@ -431,7 +509,7 @@ def sharded_blame(
         pw = ce.verify_pairwise(cfg, e_sh, s_sh, r_sh, gt, ht)  # (block, n)
         return lax.all_gather(pw, PARTY_AXIS, tiled=True)  # (n, n)
 
-    return step(e, s, r, g_table, h_table)
+    return step
 
 
 def sharded_ceremony(
@@ -499,6 +577,224 @@ def sharded_ceremony(
         qualified = jnp.asarray(~guilty)
         finals, master = sharded_finalise(cfg, mesh, a0, s, qualified)
     return ok, finals, master, qualified
+
+
+def place_sharded(mesh: Mesh, x, spec: P | None = None) -> jax.Array:
+    """Place an array onto ``mesh`` under an EXPLICIT PartitionSpec
+    (default: sharded on the party axis; pass ``P()`` for replicated
+    operands like the fixed-base tables).
+
+    ``jax.device_put`` with a NamedSharding is the one sanctioned way
+    host buffers enter the sharded ceremony: committing the layout here
+    (instead of letting the first shard_map infer-and-reshard) means
+    the deal program's inputs are already dealer-blocked, so round 1
+    starts with zero cross-device movement.  No-op when ``x`` already
+    has that sharding.
+    """
+    from jax.sharding import NamedSharding
+
+    return jax.device_put(
+        x, NamedSharding(mesh, spec if spec is not None else P(PARTY_AXIS))
+    )
+
+
+def run_sharded_ceremony(
+    cfg: ce.CeremonyConfig,
+    mesh: Mesh,
+    coeffs_a,
+    coeffs_b,
+    g_table,
+    h_table,
+    rho_bits: int = 128,
+    tamper=None,
+    seal=None,
+    ceremony_id: str = "sharded",
+    registry=None,
+):
+    """BatchedCeremony.run's mesh twin: the full instrumented ceremony,
+    inputs placed with explicit PartitionSpecs, every phase timed and
+    attributed per shard.
+
+    The device flow is exactly :func:`sharded_ceremony`'s (bit-identical
+    results — pinned by tests/test_parallel.py's subprocess oracle);
+    what this driver adds is the operational envelope the north-star
+    run publishes:
+
+    * input placement via :func:`place_sharded` (coefficients
+      dealer-sharded, tables replicated) so phase 0 starts aligned;
+    * per-phase wall clocks -> ``phases_s`` and the
+      ``mesh_collective_seconds{op}`` histogram;
+    * per-shard readiness events in obslog's ``round_head`` /
+      ``publish`` / ``round_tail`` schema (party = shard index), so
+      ``obslog.critical_path`` decomposes a sharded barrier exactly the
+      way it decomposes a networked one — the straggler it names is the
+      last shard to produce its block.  Shards are blocked in mesh
+      order, so a shard's publish timestamp includes any wait on the
+      ones before it; the LAST publish (the straggler) is exact.
+    * optionally, host-side DEM/transport overlapped per shard:
+      ``seal=(group, pks_dev, r_enc)`` routes the dealt share matrix
+      through ``dkg.hybrid_batch.seal_shares_mesh`` (the
+      seal_shares_pipeline chunk overlap lifted to mesh shards), whose
+      sealed broadcasts land in the result's ``broadcasts`` slot.
+
+    Phases (the obslog round numbers): 0 deal-commitments,
+    1 deal-shares, 2 transcript digest + Fiat-Shamir, 3 verify+finalise,
+    4 blame/re-finalise (failed batch check only).
+
+    Returns a BatchedCeremony.run-style dict: ``ok`` (pre-adjudication
+    per-recipient batch check, recipient-sharded), ``final_shares``,
+    ``master``, ``qualified``, ``rho``, plus ``phases_s``, ``events``,
+    ``mesh_shape``/``n_devices``, and ``broadcasts`` (None unless
+    ``seal`` was given).  Raises
+    ``DkgError(MISBEHAVIOUR_HIGHER_THRESHOLD)`` past t disqualified
+    dealers, like the tuple API.
+    """
+    from ..dkg.errors import DkgError, DkgErrorKind
+    from ..utils import metrics as _metrics
+    from ..utils import obslog
+
+    reg = registry if registry is not None else _metrics.REGISTRY
+    n_dev = _check_mesh(cfg, mesh)
+    reg.inc("mesh_shards_total", n_dev)
+    events: list[dict] = []
+    phases: dict[str, float] = {}
+
+    def _head(rd: int) -> float:
+        now = time.time()
+        events.append(
+            {"kind": "round_head", "ceremony_id": ceremony_id, "round": rd, "ts": now}
+        )
+        obslog.emit_current("round_head", round=rd, ceremony_id=ceremony_id)
+        return now
+
+    def _publish_shards(rd: int, out) -> None:
+        # host-observed per-shard readiness, blocked in mesh order: an
+        # early shard's timestamp may include waiting on the scan, but
+        # the last (the straggler critical_path names) is exact
+        per = list(getattr(out, "addressable_shards", ()) or ())
+        if len(per) == n_dev:
+            per.sort(key=lambda sh: sh.index[0].start or 0)
+            blocks = [sh.data for sh in per]
+        else:  # replicated output, host array, or single-device run
+            blocks = [out] * n_dev
+        for i, blk in enumerate(blocks):
+            jax.block_until_ready(blk)
+            events.append(
+                {
+                    "kind": "publish",
+                    "ceremony_id": ceremony_id,
+                    "round": rd,
+                    "party": i,
+                    "ts": time.time(),
+                }
+            )
+            obslog.emit_current(
+                "publish", round=rd, party=i, ceremony_id=ceremony_id
+            )
+
+    def _tail(rd: int, op: str, t_open: float) -> None:
+        now = time.time()
+        events.append(
+            {
+                "kind": "round_tail",
+                "ceremony_id": ceremony_id,
+                "round": rd,
+                "ts": now,
+                "timed_out": False,
+                "present": n_dev,
+                "party": n_dev - 1,
+            }
+        )
+        obslog.emit_current(
+            "round_tail",
+            round=rd,
+            ceremony_id=ceremony_id,
+            timed_out=False,
+            present=n_dev,
+        )
+        phases[op] = phases.get(op, 0.0) + (now - t_open)
+        reg.observe("mesh_collective_seconds", now - t_open, op=op)
+
+    ca = place_sharded(mesh, coeffs_a)
+    cb = place_sharded(mesh, coeffs_b)
+    gt = place_sharded(mesh, g_table, P())
+    ht = place_sharded(mesh, h_table, P())
+
+    t0 = _head(0)
+    a, e = sharded_deal_commitments(cfg, mesh, ca, cb, gt, ht)
+    _publish_shards(0, e)
+    _tail(0, "deal_commitments", t0)
+
+    t0 = _head(1)
+    s, r = sharded_deal_shares(cfg, mesh, ca, cb)
+    _publish_shards(1, s)
+    _tail(1, "deal_shares", t0)
+
+    if tamper is not None:
+        a, e, s, r = tamper(a, e, s, r)
+
+    broadcasts = None
+    if seal is not None:
+        from ..dkg import hybrid_batch as hb
+
+        group, pks_dev, r_enc = seal
+        t0 = time.time()
+        broadcasts = hb.seal_shares_mesh(
+            group, cfg, mesh, s, r, pks_dev, r_enc, gt
+        )
+        phases["seal_transport"] = time.time() - t0
+        reg.observe(
+            "mesh_collective_seconds", phases["seal_transport"], op="seal_transport"
+        )
+
+    t0 = _head(2)
+    digest = ce.sharded_transcript_digest(cfg, a, e, s, r)
+    rho = jnp.asarray(ce.fiat_shamir_rho(cfg, digest, rho_bits))
+    _publish_shards(2, rho)
+    _tail(2, "transcript_digest", t0)
+
+    # only the bare FIRST columns survive the digest (the master key's
+    # sole input); dropping the full bare tensor returns its HBM before
+    # the round-2 program runs (3.22 G at BLS n=16384)
+    a0 = a[:, 0]
+    del a
+
+    t0 = _head(3)
+    ok, finals, master = sharded_verify_finalise(
+        cfg, mesh, a0, e, s, r, g_table=gt, h_table=ht, rho=rho, rho_bits=rho_bits
+    )
+    _publish_shards(3, finals)
+    _tail(3, "verify_finalise", t0)
+
+    qualified = jnp.ones((cfg.n,), bool)
+    if not bool(_host_global(ok).all()):
+        t0 = _head(4)
+        pw = np.asarray(sharded_blame(cfg, mesh, e, s, r, gt, ht))
+        guilty = ~pw.all(axis=1)
+        if int(guilty.sum()) > cfg.t:
+            _tail(4, "blame", t0)
+            raise DkgError(
+                DkgErrorKind.MISBEHAVIOUR_HIGHER_THRESHOLD,
+                detail="guilty dealers (1-based): "
+                + ", ".join(str(j + 1) for j in np.nonzero(guilty)[0]),
+            )
+        qualified = jnp.asarray(~guilty)
+        finals, master = sharded_finalise(cfg, mesh, a0, s, qualified)
+        _publish_shards(4, finals)
+        _tail(4, "blame", t0)
+
+    return {
+        "ok": ok,
+        "final_shares": finals,
+        "master": master,
+        "qualified": qualified,
+        "rho": rho,
+        "broadcasts": broadcasts,
+        "phases_s": phases,
+        "events": events,
+        "mesh_shape": tuple(mesh.devices.shape),
+        "n_devices": n_dev,
+    }
 
 
 def _host_global(x: jax.Array) -> np.ndarray:

@@ -882,11 +882,106 @@ class CeremonyScheduler:
             else:
                 fast.append((p, snap))
         self._sign_fast_leg(fast, subs)
-        for p, snap in grid:
+        # proved steady traffic coalesces into ONE convoy acceptance
+        # (one hash screen + one RLC-MSM, sign.verify.rlc_verify_convoy)
+        # instead of a per-ticket MSM.  Seeded and tampered tickets keep
+        # the per-ticket grid verbatim: a seeded request must produce
+        # the same bytes, blame, and pass counts it always did.
+        convoyable = [
+            (p, snap)
+            for p, snap in grid
+            if p.prove and p.tamper is None and p.seed is None
+        ]
+        solo = grid
+        if len(convoyable) >= 2:
+            self._sign_convoy_rlc(convoyable, subs)
+            taken = {id(p) for p, _snap in convoyable}
+            solo = [(p, snap) for p, snap in grid if id(p) not in taken]
+        for p, snap in solo:
             try:
                 p.sigs = self._sign_grid_one(p, snap, subs)
             except errors.ServiceError as exc:
                 p.error = exc  # typed (InsufficientSigners...): solo parity
+            except Exception as exc:  # noqa: BLE001 — lane must conclude
+                self._poison_sign_one(p, exc)
+
+    def _sign_convoy_rlc(self, tickets, subs) -> None:
+        """Proved-traffic convoy acceptance: every ticket draws its
+        quorum and signs its grid exactly as the per-ticket path would,
+        then ONE combined hash screen + RLC-MSM accepts the whole
+        convoy.  Tickets the combined check cannot vouch for (a
+        screen-failing cell, or an undifferentiated combined failure)
+        replay on :meth:`_sign_grid_one` from scratch — the per-ticket
+        path owns bisecting blame and quarantine, so fault semantics
+        are untouched; only the overwhelmingly common all-honest convoy
+        pays the single pass.  The convoy's pass count lands on the
+        first accepted ticket (totals across tickets stay equal to
+        MSM passes actually performed)."""
+        from .. import sign as signing
+        from ..sign import verify as sign_verify
+
+        prepared = []  # (p, snap, ps, quorum)
+        for p, snap in tickets:
+            mat, t, qualified = snap
+            try:
+                eligible = self._sign_eligible(p, qualified)
+                if len(eligible) < t + 1:
+                    raise self._sign_starved(p, eligible, t + 1)
+                th0 = time.monotonic()
+                h_points, _ = signing.hash_to_curve_batch(
+                    mat.curve, list(p.msgs)
+                )
+                subs["hash_s"] += time.monotonic() - th0
+                rng = random.SystemRandom()
+                quorum = sorted(rng.sample(eligible, t + 1))
+                tp0 = time.monotonic()
+                ps = signing.partial_sign(
+                    mat.curve,
+                    [mat.shares[i - 1] for i in quorum],
+                    quorum,
+                    h_points,
+                    rng=rng,
+                    prove=True,
+                    pks=self.sign_cache.quorum_pks(mat, quorum),
+                )
+                subs["partial_s"] += time.monotonic() - tp0
+                prepared.append((p, snap, ps, quorum))
+            except errors.ServiceError as exc:
+                p.error = exc
+            except Exception as exc:  # noqa: BLE001 — lane must conclude
+                self._poison_sign_one(p, exc)
+        if not prepared:
+            return
+        tv0 = time.monotonic()
+        report = sign_verify.rlc_verify_convoy(
+            [ps for _p, _snap, ps, _q in prepared]
+        )
+        subs["verify_s"] += time.monotonic() - tv0
+        self.metrics.inc(
+            "sign_convoy_rlc_total",
+            result="ok" if report.ok else "fallback",
+        )
+        credited = False
+        for k, (p, snap, ps, quorum) in enumerate(prepared):
+            if not report.grid_ok[k]:
+                try:
+                    p.sigs = self._sign_grid_one(p, snap, subs)
+                except errors.ServiceError as exc:
+                    p.error = exc
+                except Exception as exc:  # noqa: BLE001 — lane must conclude
+                    self._poison_sign_one(p, exc)
+                continue
+            try:
+                ta0 = time.monotonic()
+                curve = ps.curve
+                lam = self.sign_cache.lagrange_at_zero(curve, tuple(quorum))[1]
+                p.sigs = signing.signature_encode(
+                    curve, signing.aggregate(ps, lam=lam)
+                )
+                subs["aggregate_s"] += time.monotonic() - ta0
+                p.rlc_passes = 0 if credited else report.passes
+                credited = True
+                p.signers = len(quorum)
             except Exception as exc:  # noqa: BLE001 — lane must conclude
                 self._poison_sign_one(p, exc)
 
@@ -963,6 +1058,15 @@ class CeremonyScheduler:
             msgs.extend(p.msgs)
             rows.extend([sigma] * len(p.msgs))
         rows = np.asarray(rows)  # (B, L)
+        # DKG_TPU_SIGN_MESH=1: the rung ladder shards over the device
+        # axis (parallel.signmesh owns the mesh and the shard_map; the
+        # lane just routes) — limb-identical to the single-device rung,
+        # byte-checked against the host oracle by sign_bench --steady
+        from ..parallel import signmesh
+
+        mesh = signmesh.sign_mesh()
+        if mesh is not None:
+            self.metrics.set_gauge("sign_mesh_devices", mesh.devices.size)
         pending = []
         t_partial = 0.0
         for a, b in buckets.sign_rung_slices(len(msgs), self.sign_batch_max):
@@ -970,7 +1074,13 @@ class CeremonyScheduler:
             _, h_dev = signing.hash_to_curve_batch(curve, msgs[a:b])
             tp0 = time.monotonic()
             subs["hash_s"] += tp0 - th0
-            pending.append(signing.sign_folded(curve, rows[a:b], h_dev))
+            if mesh is not None:
+                self.metrics.inc("sign_mesh_rungs_total")
+                pending.append(
+                    signmesh.sign_folded_sharded(curve, rows[a:b], h_dev, mesh)
+                )
+            else:
+                pending.append(signing.sign_folded(curve, rows[a:b], h_dev))
             t_partial += time.monotonic() - tp0
         ta0 = time.monotonic()
         wire = signing.signature_encode(

@@ -17,7 +17,12 @@ threshold-signature service, pairing-free by construction:
 * :mod:`.verify` — RLC-combined grid verification with bisecting blame:
   accept an all-honest grid in ONE combined check, locate Byzantine
   (message, signer) cells in O(log) further checks — the primitive
-  behind the scheduler's signer quarantine.
+  behind the scheduler's signer quarantine.  ``rlc_verify_convoy``
+  extends the same soundness argument across a whole convoy of proved
+  grids: steady proved traffic pays one hash screen plus ONE RLC-MSM
+  total, with screen-failing grids excluded up front and an
+  undifferentiated combined failure routing every surviving grid back
+  through the per-grid bisection path.
 * :mod:`.cache` — the steady-state lane's warm-path caches: decoded
   share vectors per (ceremony, epoch), Lagrange-at-zero coefficients
   per (curve, quorum), per-quorum public keys, and the folded signing
@@ -43,10 +48,11 @@ from .partial import (
     sign_folded,
     verify_partials,
 )
-from .verify import RlcReport, rlc_verify
+from .verify import ConvoyReport, RlcReport, rlc_verify, rlc_verify_convoy
 
 __all__ = [
     "CeremonyMaterial",
+    "ConvoyReport",
     "PartialSignatures",
     "RlcReport",
     "SignCache",
@@ -59,6 +65,7 @@ __all__ = [
     "partial_sign_host",
     "public_keys",
     "rlc_verify",
+    "rlc_verify_convoy",
     "sign_folded",
     "signature_encode",
     "verify_partials",

@@ -157,6 +157,90 @@ def _rlc_check(
     return group.is_identity(host_pt)
 
 
+@dataclasses.dataclass(frozen=True)
+class ConvoyReport:
+    """One :func:`rlc_verify_convoy` outcome.
+
+    ``grid_ok[i]`` is True iff grid i's every cell survived the hash
+    screen AND the single combined RLC pass over all screen-surviving
+    grids held.  When that combined pass fails, EVERY screen-surviving
+    grid reports False — the check is an acceptance gate, not a blame
+    primitive; callers route implicated grids through per-grid
+    :func:`rlc_verify`, which owns bisection.  ``passes``: combined
+    group-level checks performed (1 when any grid survived the screen,
+    0 otherwise).  ``cells``: total cells across the convoy.
+    """
+
+    ok: bool
+    grid_ok: tuple[bool, ...]
+    passes: int
+    cells: int
+
+
+def rlc_verify_convoy(
+    batch: list[PartialSignatures],
+    *,
+    rng=None,
+    dispatch: str | None = None,
+) -> ConvoyReport:
+    """Accept a whole convoy of proved grids with ONE hash screen and
+    ONE RLC-MSM.
+
+    The per-grid path pays one (5k+1)-point MSM per *request*; steady
+    proved traffic coalesced into a convoy shares the same soundness
+    argument over the concatenated cell list (fresh per-cell weights
+    make the combined sum identity iff every cell of every grid holds,
+    Schwartz–Zippel as above), so the convoy pays one MSM total.  Grids
+    with a screen-failing cell are excluded from the combined check and
+    reported bad immediately — a tampered signature never costs the
+    honest grids their single pass.
+
+    ``rng`` draws the weights (default SystemRandom — weights must be
+    unpredictable to the signers).  All grids must share one curve.
+    """
+    if not batch:
+        return ConvoyReport(ok=True, grid_ok=(), passes=0, cells=0)
+    curves = {ps.curve for ps in batch}
+    if len(curves) > 1:
+        raise ValueError(f"convoy spans curves {sorted(curves)}; expected one")
+    for ps in batch:
+        if ps.proofs is None or ps.announcements is None:
+            raise ValueError(
+                "rlc_verify_convoy needs proofs and announcements "
+                "(partial_sign(..., prove=True))"
+            )
+    group = gh.ALL_GROUPS[batch[0].curve]
+    cs = gd.ALL_CURVES[batch[0].curve]
+    mode = _rlc_dispatch(dispatch)
+    if rng is None:
+        rng = random.SystemRandom()
+    grid_ok = [True] * len(batch)
+    survivors: list[tuple] = []
+    cells = 0
+    for gi, ps in enumerate(batch):
+        rows = _cell_rows(ps)
+        cells += len(rows)
+        clean = True
+        for e, _z, h, pk, sig, a1, a2, g in rows:
+            if e != _challenge(group, g, h, pk, sig, a1, a2):
+                clean = False
+                break
+        if clean:
+            survivors.extend(rows)
+        else:
+            grid_ok[gi] = False
+    passes = 0
+    if survivors:
+        passes = 1
+        if not _rlc_check(group, cs, survivors, rng, mode):
+            # undifferentiated failure: every surviving grid goes back
+            # to the per-grid path, which bisects to the bad cells
+            grid_ok = [False] * len(batch)
+    return ConvoyReport(
+        ok=all(grid_ok), grid_ok=tuple(grid_ok), passes=passes, cells=cells
+    )
+
+
 def rlc_verify(
     ps: PartialSignatures,
     *,

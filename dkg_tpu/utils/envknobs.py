@@ -42,6 +42,13 @@ window, allowed failure ratio, and latency objectives) via
 service.slo,
 DKG_TPU_SIGN_RLC_DISPATCH (host|device RLC combine leg) via
 sign.verify,
+DKG_TPU_SIGN_MESH (0|1|force — shard the steady lane's folded sign
+ladder over the device mesh; 1 engages only where shards run
+concurrently (accelerator backend or a multi-core host), force on any
+>=2-device mesh; the Mesh handle and shard_map live in
+parallel.signmesh, per lint rule DKG015) via parallel.signmesh,
+DKG_TPU_NORTH_STAR (bench.py: 1 forces the north-star sharded rung on
+any platform, 0 skips it; read by the driver scripts, not dkg_tpu/),
 DKG_TPU_EPOCH_MAX_CHURN (leave+join budget a reshare accepts; 0
 refuses any membership change) and DKG_TPU_EPOCH_DEADLINE_S
 (per-epoch-round fetch timeout) via dkg_tpu.epoch.manager — lint

@@ -123,6 +123,17 @@ linter), so the committed baseline stays clean between CI runs:
         or group code would bypass the backend gating, the
         ``pallas_calls_total`` accounting, and the bit-exactness test
         tiers (docs/perf.md "MXU formulation")
+* DKG015  (dkg_tpu/ only, dkg_tpu/parallel/ exempt) mesh machinery
+        constructed outside the parallel layer: a ``Mesh`` /
+        ``PartitionSpec`` / ``NamedSharding`` construction or a
+        ``shard_map`` call — and the jax imports that provide them —
+        anywhere else in the library.  Sharding topology has exactly
+        one owner (``parallel/mesh.py``'s PARTY_AXIS convention, its
+        ``_shard_map_nocheck`` version seam, ``parallel/signmesh.py``'s
+        sign-lane mesh); call sites take a mesh HANDLE
+        (``make_mesh``/``sign_mesh``) so axis names, check-kwarg
+        compatibility, and placement policy cannot fork per module
+        (docs/perf.md "Sharded ceremony")
 
 Exit 0 = clean.  Run: ``python scripts/lint_lite.py`` (from repo root).
 Also executed by tests/test_import_hygiene.py so the default test tier
@@ -279,6 +290,16 @@ _DKG013_CACHED_DERIVATIONS = {
     "public_keys",
 }
 
+# Mesh machinery banned outside dkg_tpu/parallel/ (DKG015): sharding
+# topology (axis names, PartitionSpecs, the shard_map version seam)
+# has exactly one owner; everyone else takes a mesh handle.
+_DKG015_MESH_MACHINERY = {
+    "Mesh",
+    "PartitionSpec",
+    "NamedSharding",
+    "shard_map",
+}
+
 
 class _Checker(ast.NodeVisitor):
     def __init__(self, path: pathlib.Path, tree: ast.Module, source: str):
@@ -298,6 +319,7 @@ class _Checker(ast.NodeVisitor):
         self._ops_module = "dkg_tpu/ops/" in path.as_posix()
         self._epoch_module = "dkg_tpu/epoch/" in path.as_posix()
         self._sign_module = "dkg_tpu/sign/" in path.as_posix()
+        self._parallel_module = "dkg_tpu/parallel/" in path.as_posix()
         self._dem_hot_module = (
             self._dkg_module and path.name in _DEM_HOT_MODULES
         )
@@ -378,6 +400,24 @@ class _Checker(ast.NodeVisitor):
             local = alias.asname or alias.name
             reexport = alias.asname is not None and alias.asname == alias.name
             self.imports.append((node.lineno, local, "F401", reexport))
+            # DKG015a: importing mesh machinery from jax outside the
+            # parallel layer — aliasing (``PartitionSpec as P``) is the
+            # common spelling, so the import is where the rule bites.
+            if (
+                self._pkg_module
+                and not self._parallel_module
+                and node.module
+                and node.module.split(".")[0] == "jax"
+                and alias.name in _DKG015_MESH_MACHINERY
+            ):
+                self._add(
+                    node,
+                    "DKG015",
+                    f"{alias.name} imported from {node.module} outside "
+                    "dkg_tpu/parallel/ — sharding topology has one owner; "
+                    "take a mesh handle (parallel.mesh.make_mesh / "
+                    "parallel.signmesh.sign_mesh) instead",
+                )
         self.generic_visit(node)
 
     # -- rules ---------------------------------------------------------
@@ -793,6 +833,24 @@ class _Checker(ast.NodeVisitor):
                     "pallas_call outside dkg_tpu/ops/ — kernels live in "
                     "the ops layer behind fused_kernels_active and the "
                     "interpret/Mosaic dispatch seams",
+                )
+        # DKG015b: mesh machinery constructed outside the parallel
+        # layer — a Mesh/PartitionSpec/NamedSharding construction or a
+        # shard_map call anywhere else forks the topology ownership
+        # (axis names, the check-kwarg version seam, placement policy).
+        if self._pkg_module and not self._parallel_module:
+            func = node.func
+            name = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else ""
+            )
+            if name in _DKG015_MESH_MACHINERY:
+                self._add(
+                    node,
+                    "DKG015",
+                    f"{name}() outside dkg_tpu/parallel/ — sharding "
+                    "topology has one owner; take a mesh handle "
+                    "(parallel.mesh.make_mesh / parallel.signmesh."
+                    "sign_mesh) instead",
                 )
         # DKG004b: a hashlib.blake2b call lexically inside a loop in a
         # batch hot module is a per-dealer host hash loop — use

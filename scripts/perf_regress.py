@@ -62,6 +62,17 @@ with a note.  Rounds carrying a ``steady_state`` block (sign_bench
 ``steady_state.signatures_per_s`` the same way; an older round that
 predates steady-state mode skips that leg with a note.
 
+The north-star scale run: ``NORTHSTAR_r{NN}.json`` rounds
+(scripts/northstar_bench.py — the mesh-sharded ceremony measured at the
+largest honest shape, bench.py's ``north_star`` slot embeds the same
+dict) gate two ways.  FLOOR on the newest round:
+``bit_exact_vs_unsharded`` must be true — a sharded ceremony that
+drifts from the single-chip engine is a correctness bug whatever its
+speed.  DIFF newest-two: FAIL when ``wall_s`` ROSE more than the
+threshold at a matching (curve, n, t, mesh_shape, platform) key;
+mismatched keys are incomparable (a different rung or a different box)
+and skip with a note, as does a history with fewer than two rounds.
+
 The service chaos storm: ``SVCSTORM_r{NN}.json`` rounds
 (scripts/service_storm.py) gate FLOORS on the newest round rather than
 a newest-two diff — resilience is an invariant, not a rate.  FAIL when
@@ -90,6 +101,7 @@ _FLEET_PAT = re.compile(r"FLEET_r(\d+)\.json$")
 _EPOCH_PAT = re.compile(r"EPOCH_r(\d+)\.json$")
 _SIGN_PAT = re.compile(r"SIGN_r(\d+)\.json$")
 _SVCSTORM_PAT = re.compile(r"SVCSTORM_r(\d+)\.json$")
+_NORTHSTAR_PAT = re.compile(r"NORTHSTAR_r(\d+)\.json$")
 
 
 def _load_rounds(root: pathlib.Path) -> list[tuple[int, dict]]:
@@ -136,6 +148,7 @@ def main(argv: list[str] | None = None) -> int:
         or epoch_gate(root, args.threshold)
         or sign_gate(root, args.threshold)
         or svcstorm_gate(root)
+        or northstar_gate(root, args.threshold)
         or _slo_gate(root)
     )
 
@@ -729,6 +742,99 @@ def svcstorm_gate(root: pathlib.Path) -> int:
             f"perf_regress: storm r{new_n} has no sign leg — convoy "
             "floors only"
         )
+    return bad
+
+
+def _load_northstar_rounds(root: pathlib.Path) -> list[tuple[int, dict]]:
+    """(round number, report) for every usable north-star round,
+    ascending — usable means the run actually measured something
+    (``wall_s`` > 0); an infra-dead round skips rather than blocks."""
+    out: list[tuple[int, dict]] = []
+    for path in sorted(root.glob("NORTHSTAR_r*.json")):
+        m = _NORTHSTAR_PAT.search(path.name)
+        if not m:
+            continue
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError):
+            continue
+        if not isinstance(doc, dict):
+            continue
+        wall = doc.get("wall_s")
+        if not isinstance(wall, (int, float)) or wall <= 0:
+            continue
+        out.append((int(m.group(1)), doc))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+def northstar_gate(root: pathlib.Path, threshold: float) -> int:
+    """Gate the north-star sharded-ceremony history.
+
+    FLOOR on the newest round: ``bit_exact_vs_unsharded`` must be true
+    — a sharded ceremony that drifts from the single-chip engine is
+    wrong whatever its speed.  DIFF newest-two: ``wall_s`` must not
+    RISE more than ``threshold`` at a matching
+    (curve, n, t, mesh_shape, platform) key; a different rung or a
+    different box is incomparable and skips with a note.
+    """
+    rounds = _load_northstar_rounds(root)
+    if not rounds:
+        print(f"perf_regress: no usable north-star round in {root} — skipping")
+        return 0
+    new_n, new = rounds[-1]
+    bad = 0
+    if not new.get("bit_exact_vs_unsharded"):
+        print(
+            f"perf_regress: northstar r{new_n} sharded ceremony is NOT "
+            f"bit-exact vs unsharded at shape "
+            f"{new.get('bit_exact_shape')!r} — CORRECTNESS FLOOR VIOLATED",
+            file=sys.stderr,
+        )
+        bad = 1
+    else:
+        print(
+            f"perf_regress: northstar r{new_n} bit-exact vs unsharded "
+            f"at shape {new.get('bit_exact_shape')!r}"
+        )
+    if len(rounds) < 2:
+        print(
+            f"perf_regress: {len(rounds)} usable north-star round(s) in "
+            f"{root} — nothing to diff"
+        )
+        return bad
+
+    def key(doc: dict) -> tuple:
+        return (
+            doc.get("curve"),
+            doc.get("n"),
+            doc.get("t"),
+            tuple(doc.get("mesh_shape") or ()),
+            doc.get("platform"),
+        )
+
+    old_n, old = rounds[-2]
+    old_key, new_key = key(old), key(new)
+    if old_key != new_key:
+        print(
+            f"perf_regress: northstar shapes differ "
+            f"(r{old_n} {old_key} vs r{new_n} {new_key}) "
+            "— incomparable, skipping the wall gate"
+        )
+        return bad
+    old_v, new_v = old["wall_s"], new["wall_s"]
+    change = (new_v - old_v) / old_v
+    curve, n, t, mesh_shape, platform = new_key
+    line = (
+        f"perf_regress: northstar {curve} n={n} t={t} "
+        f"mesh={list(mesh_shape)} wall_s r{old_n} {old_v:.3f} -> "
+        f"r{new_n} {new_v:.3f} ({change:+.1%}) on {platform}"
+    )
+    if change > threshold:
+        print(f"{line} — REGRESSION beyond {threshold:.0%}", file=sys.stderr)
+        bad = 1
+    else:
+        print(line)
     return bad
 
 

@@ -232,6 +232,32 @@ def bench_steady(
             tamper=lambda ps: ps,
         )
         correct &= grid == sigs[:grid_n]
+        # byte-identity leg 3: the device-sharded folded lane.  The
+        # measured window ran whatever DKG_TPU_SIGN_MESH's auto logic
+        # picked (recorded below); here a sample batch re-signs with
+        # the mesh FORCEd so the sharded ladder's bytes are pinned
+        # against the measured lane (and thereby the host oracle) in
+        # every published round, even on boxes where auto declines
+        from dkg_tpu.parallel import signmesh
+
+        mesh_auto = signmesh.sign_mesh()
+        mesh_n = min(batch, total)
+        saved = os.environ.get("DKG_TPU_SIGN_MESH")
+        os.environ["DKG_TPU_SIGN_MESH"] = "force"
+        try:
+            forced = signmesh.sign_mesh()
+            mesh_checked = 0
+            if forced is not None:
+                meshed = sch.sign(
+                    "steady", msgs[:mesh_n], prove=False, seed=seed
+                )
+                correct &= meshed == sigs[:mesh_n]
+                mesh_checked = mesh_n
+        finally:
+            if saved is None:
+                os.environ.pop("DKG_TPU_SIGN_MESH", None)
+            else:
+                os.environ["DKG_TPU_SIGN_MESH"] = saved
     finally:
         sch.close()
 
@@ -246,6 +272,16 @@ def bench_steady(
         "signatures_per_s": round(total / wall, 1),
         "oracle_checked": total,
         "grid_checked": grid_n,
+        "sign_mesh": {
+            "knob": saved,
+            "measured_devices": (
+                int(mesh_auto.devices.size) if mesh_auto is not None else 0
+            ),
+            "forced_devices": (
+                int(forced.devices.size) if forced is not None else 0
+            ),
+            "forced_checked": mesh_checked,
+        },
         "correct": correct,
     }
 

@@ -295,6 +295,80 @@ def test_rlc_dispatch_knob(monkeypatch):
         sv._rlc_dispatch("tpu")
 
 
+# ------------------------------------------------------- convoy RLC accept
+
+
+def _second_grid(curve: str):
+    """A second proved grid over a different quorum ([2,3,4]) of the
+    same sharing — the cross-request shape a steady convoy coalesces."""
+    base = _base(curve)
+    idx = [2, 3, 4]
+    return sg.partial_sign(
+        curve,
+        [base["shares"][i - 1] for i in idx],
+        idx,
+        base["h_points"],
+        rng=random.Random(11),
+        prove=True,
+    )
+
+
+def test_rlc_verify_convoy_accepts_two_grids_in_one_pass():
+    """Two honest proved grids cost the convoy exactly ONE combined
+    RLC-MSM — the whole point of coalescing steady proved traffic."""
+    report = sg.rlc_verify_convoy(
+        [_ctx("secp256k1")["ps"], _second_grid("secp256k1")],
+        rng=random.Random(51),
+    )
+    assert report.ok
+    assert report.grid_ok == (True, True)
+    assert report.passes == 1, "a convoy pays one MSM, not one per grid"
+    assert report.cells == 2 * len(MESSAGES) * (T + 1)
+
+
+def test_rlc_verify_convoy_hash_screen_excludes_only_the_bad_grid():
+    """A tampered signature breaks the Fiat-Shamir binding at host-hash
+    cost: the bad grid is excluded and reported, the honest grid still
+    gets its single accepted pass."""
+    ps = _ctx("secp256k1")["ps"]
+    forged = dataclasses.replace(ps, sigs=ps.sigs.copy())
+    forged.sigs[0, 1] = ps.sigs[0, 0]
+    report = sg.rlc_verify_convoy(
+        [_second_grid("secp256k1"), forged], rng=random.Random(52)
+    )
+    assert not report.ok
+    assert report.grid_ok == (True, False)
+    assert report.passes == 1
+
+
+def test_rlc_verify_convoy_group_failure_implicates_all_survivors():
+    """A tampered z survives the screen; the combined check fails and
+    CANNOT attribute, so every screen-surviving grid reports bad — the
+    caller's cue to fall back to per-grid rlc_verify bisection."""
+    forged = _z_tampered(_ctx("secp256k1")["ps"], 0, 1)
+    report = sg.rlc_verify_convoy(
+        [forged, _second_grid("secp256k1")], rng=random.Random(53)
+    )
+    assert not report.ok
+    assert report.grid_ok == (False, False)
+    assert report.passes == 1
+    # the fallback path then bisects to the exact cell
+    blame = sg.rlc_verify(forged, rng=random.Random(54))
+    assert blame.bad_cells == ((0, 1),)
+
+
+def test_rlc_verify_convoy_validates_inputs():
+    ps = _ctx("secp256k1")["ps"]
+    assert sg.rlc_verify_convoy([]) == sg.ConvoyReport(
+        ok=True, grid_ok=(), passes=0, cells=0
+    )
+    with pytest.raises(ValueError, match="announcements"):
+        sg.rlc_verify_convoy([dataclasses.replace(ps, proofs=None)])
+    ps2 = dataclasses.replace(_second_grid("secp256k1"), curve="ristretto255")
+    with pytest.raises(ValueError, match="curves"):
+        sg.rlc_verify_convoy([ps, ps2])
+
+
 @pytest.mark.slow
 def test_rlc_verify_device_dispatch_parity():
     """The padded device MSM leg reaches the same verdicts as the
