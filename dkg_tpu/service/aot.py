@@ -1,0 +1,388 @@
+"""AOT-serialized executable store: kill the per-process cold start.
+
+A fresh serving process pays minutes of XLA compiles before its first
+ceremony (FLEET_r01: 222.6s of warmup) even though every hot program is
+static per (curve, bucket shape, convoy width, sign rung).  This module
+persists the *compiled executables themselves* — lowered + compiled once
+via ``jax.jit(...).lower(specs).compile()``, serialized with
+:mod:`jax.experimental.serialize_executable` — beside the fixed-base
+table cache, exactly on :mod:`dkg_tpu.groups.precompute`'s store
+contract:
+
+* process-level cache first (RLock-guarded dict), then a validated disk
+  load, then build-and-persist;
+* atomic writes (``mkstemp`` + ``os.replace``) so concurrent worker
+  processes never observe a torn file;
+* every artifact carries a BLAKE2b digest over a header binding the
+  format version, jax/jaxlib versions, backend, knob tier and the full
+  program key — corruption, truncation or version skew all fail the
+  digest check and fall through to a silent rebuild (counted in
+  :func:`stats`), never a crash and never a stale program.
+
+The store is OFF unless ``DKG_TPU_AOT_DIR`` is set (the engine then
+dispatches through its jitted twins exactly as before): XLA:CPU's
+*compilation-cache* writer has corrupted entries on some images
+(tests/conftest.py), so opting into executable persistence is an
+explicit deployment decision.  ``serialize_executable`` takes a
+different path (PjRt executable serialize + pickle) and round-trips this
+package's large CPU executables bit-identically, but the loaded blob is
+a pickle: the digest check guards *integrity*, not *trust* — point
+``DKG_TPU_AOT_DIR`` only at a directory you would also trust as a JAX
+compilation cache.
+
+Key shape: ``(kind, curve, n, t, width, rho_bits, specsig)`` for
+ceremony programs, ``("sign_folded", curve, rung, specsig)`` for the
+steady sign lane's folded ladder rungs — ``specsig`` pins every operand
+shape/dtype (tables included, so a fixed-base window change keys new
+artifacts).  :func:`preload` deserializes every valid artifact in the
+store into the process cache so a fresh worker warms in seconds;
+:func:`has_prefix` lets :meth:`WarmRuntime.warmup
+<dkg_tpu.service.engine.WarmRuntime.warmup>` skip its throwaway convoy
+when a bucket's programs are already resident.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+import pickle
+import tempfile
+import threading
+import time
+
+import jax
+import numpy as np
+from jax.experimental import serialize_executable as _se
+
+from ..utils import envknobs
+from ..utils.metrics import REGISTRY
+
+#: Bump when the artifact layout changes; old files fail the digest
+#: check and silently rebuild.
+_FORMAT_VERSION = 1
+
+#: Knobs that change the traced program at fixed shapes: two processes
+#: with different tiers must never serve each other's executables, so
+#: the resolved tier string is bound into every artifact digest.
+_TIER_KNOBS = (
+    "DKG_TPU_REDUCE",
+    "DKG_TPU_CARRY",
+    "DKG_TPU_MUL",
+    "DKG_TPU_MXU",
+    "DKG_TPU_PALLAS",
+    "DKG_TPU_FUSED_MULTI",
+    "DKG_TPU_ED_FUSED_LADDER",
+    "DKG_TPU_ED_FUSED_DOUBLES",
+    "DKG_TPU_MSM",
+    "DKG_TPU_FB_WINDOW",
+    "DKG_TPU_DIGEST",
+    "DKG_TPU_DEAL_CHUNK",
+    "DKG_TPU_VERIFY_CHUNK",
+    "DKG_TPU_RLC",
+    "DKG_TPU_RLC_CHUNK",
+    "DKG_TPU_DEM",
+    "DKG_TPU_DEM_CHUNK",
+)
+
+_LOCK = threading.RLock()
+_PROC: dict[tuple, object] = {}
+_STATS = {
+    "builds": 0,
+    "build_s": 0.0,
+    "disk_loads": 0,
+    "load_s": 0.0,
+    "disk_rejects": 0,
+    "proc_hits": 0,
+    "errors": 0,
+}
+_PRELOADED = False
+#: Lazy {key: path} disk index (``_scan_disk``); None until first scan.
+_DISK: dict | None = None
+
+
+def enabled() -> bool:
+    """True when the store is active (``DKG_TPU_AOT_DIR`` set)."""
+    return envknobs.string("DKG_TPU_AOT_DIR", "AOT executable store directory") is not None
+
+
+def cache_dir() -> str:
+    """The artifact directory: ``DKG_TPU_AOT_DIR``, else beside the JAX
+    compilation cache, else the system temp dir (mirrors
+    precompute.cache_dir so the two stores land together)."""
+    override = envknobs.string("DKG_TPU_AOT_DIR", "AOT executable store directory")
+    if override:
+        return override
+    base = jax.config.jax_compilation_cache_dir or tempfile.gettempdir()
+    return os.path.join(base, "dkg_tpu_aot_store")
+
+
+def knob_tier() -> str:
+    """Canonical ``k=v`` string of every set program-shaping knob."""
+    parts = []
+    for name in _TIER_KNOBS:
+        v = envknobs.string(name, "program-shaping knob (AOT tier)")
+        if v is not None:
+            parts.append(f"{name}={v}")
+    return ",".join(parts)
+
+
+def spec_sig(args: tuple) -> tuple:
+    """Shape/dtype signature of a tuple of (pytree) operands — part of
+    every key, so executables are only ever served to calls with the
+    exact operand layout they were compiled for."""
+    out = []
+    for a in args:
+        for leaf in jax.tree_util.tree_leaves(a):
+            out.append((tuple(np.shape(leaf)), str(leaf.dtype)))
+    return tuple(out)
+
+
+def _header(key: tuple) -> bytes:
+    import jaxlib
+
+    return (
+        f"aot|{_FORMAT_VERSION}|{jax.__version__}|{jaxlib.__version__}|"
+        f"{jax.default_backend()}|{knob_tier()}|{key!r}"
+    ).encode()
+
+
+def _digest(header: bytes, blob: bytes) -> bytes:
+    h = hashlib.blake2b(digest_size=32)
+    h.update(header)
+    h.update(blob)
+    return h.digest()
+
+
+def _path(key: tuple) -> str:
+    tag = hashlib.blake2b(repr(key).encode(), digest_size=8).hexdigest()
+    return os.path.join(cache_dir(), f"aot_v{_FORMAT_VERSION}_{key[0]}_{tag}.npz")
+
+
+def _load_blob(path: str, key: tuple):
+    """Deserialize one artifact; None on ANY failure (missing, torn,
+    digest mismatch, version skew, unloadable executable)."""
+    t0 = time.perf_counter()
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            blob = z["blob"].tobytes()
+            digest = z["digest"].tobytes()
+            stored_key = z["key"].tobytes().decode()
+        if stored_key != repr(key):
+            raise ValueError("key mismatch")
+        if digest != _digest(_header(key), blob):
+            raise ValueError("digest mismatch")
+        fn = _se.deserialize_and_load(*pickle.loads(blob))
+    except FileNotFoundError:
+        return None
+    except Exception:
+        _STATS["disk_rejects"] += 1
+        REGISTRY.inc("aot_disk_rejects_total")
+        return None
+    dt = time.perf_counter() - t0
+    _STATS["disk_loads"] += 1
+    _STATS["load_s"] += dt
+    REGISTRY.inc("aot_disk_loads_total")
+    REGISTRY.observe("aot_load_seconds", dt)
+    return fn
+
+
+def _persist(path: str, key: tuple, blob: bytes) -> None:
+    """Atomic npz write; an unwritable store degrades silently (the
+    freshly compiled executable still serves this process)."""
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(path), suffix=".tmp.npz"
+        )
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(
+                    f,
+                    blob=np.frombuffer(blob, np.uint8),
+                    digest=np.frombuffer(_digest(_header(key), blob), np.uint8),
+                    key=np.frombuffer(repr(key).encode(), np.uint8),
+                )
+            os.replace(tmp, path)
+            with _LOCK:
+                if _DISK is not None:
+                    _DISK[key] = path
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+    except OSError:
+        pass
+
+
+def get_or_build(key: tuple, build):
+    """The store's one lookup: process cache -> validated disk load ->
+    ``build()`` (a thunk returning a ``jax.stages.Compiled``) + persist.
+    Returns a loaded executable callable with the program's dynamic
+    (non-static) operands."""
+    with _LOCK:
+        hit = _PROC.get(key)
+        if hit is not None:
+            _STATS["proc_hits"] += 1
+            return hit
+        path = _path(key)
+        fn = _load_blob(path, key)
+        if fn is None:
+            t0 = time.perf_counter()
+            fn = build()
+            dt = time.perf_counter() - t0
+            _STATS["builds"] += 1
+            _STATS["build_s"] += dt
+            REGISTRY.inc("aot_builds_total")
+            REGISTRY.observe("aot_build_seconds", dt)
+            try:
+                blob = pickle.dumps(_se.serialize(fn), protocol=4)
+                _persist(path, key, blob)
+            except Exception:
+                # some backends can't serialize; the compiled program
+                # still serves this process
+                _STATS["errors"] += 1
+                REGISTRY.inc("aot_errors_total")
+        _PROC[key] = fn
+        return fn
+
+
+def _scan_disk() -> dict:
+    """{key: path} of every parseable artifact in the store (one cheap
+    directory scan; only the small ``key`` member of each npz is read,
+    never the executable blob).  Cached per process; :func:`_persist`
+    keeps it current for this process's own writes."""
+    global _DISK
+    with _LOCK:
+        if _DISK is not None:
+            return _DISK
+        disk: dict = {}
+        try:
+            names = sorted(os.listdir(cache_dir()))
+        except OSError:
+            names = []
+        for name in names:
+            if not (name.startswith("aot_v") and name.endswith(".npz")):
+                continue
+            path = os.path.join(cache_dir(), name)
+            try:
+                with np.load(path, allow_pickle=False) as z:
+                    key = ast.literal_eval(z["key"].tobytes().decode())
+            except Exception:
+                _STATS["disk_rejects"] += 1
+                REGISTRY.inc("aot_disk_rejects_total")
+                continue
+            if isinstance(key, tuple) and key and isinstance(key[0], str):
+                disk[key] = path
+        _DISK = disk
+        return disk
+
+
+def disk_has_prefix(prefix: tuple) -> bool:
+    """True when the store holds an artifact whose key starts with
+    ``prefix`` — resident or not.  Lets warmup skip its throwaway
+    convoy (the compile) while leaving the deserialize to first
+    dispatch (lazy loads are seconds; compiles are minutes)."""
+    if has_prefix(prefix):
+        return True
+    return any(k[: len(prefix)] == prefix for k in _scan_disk())
+
+
+def preload_prefixes(prefixes) -> int:
+    """Deserialize only the artifacts matching ``prefixes`` into the
+    process cache — the warmup path's targeted load.  On a one-core
+    host the full store deserializes at ~6 MB/s, so a worker preloads
+    just its steady convoy shape and lets the long tail load lazily.
+    Returns how many executables became resident."""
+    prefixes = [tuple(p) for p in prefixes]
+    loaded = 0
+    for key, path in sorted(_scan_disk().items()):
+        if not any(key[: len(p)] == p for p in prefixes):
+            continue
+        with _LOCK:
+            if key in _PROC:
+                continue
+            fn = _load_blob(path, key)
+            if fn is not None:
+                _PROC[key] = fn
+                loaded += 1
+            REGISTRY.set_gauge("aot_resident_executables", len(_PROC))
+    return loaded
+
+
+def preload(max_seconds: float | None = None) -> int:
+    """Deserialize every valid artifact in the store into the process
+    cache (idempotent; at most once per process unless :func:`reset`).
+    Returns the number of resident executables.  ``max_seconds`` bounds
+    the scan so a worker's warmup budget is respected — remaining
+    artifacts load lazily on first dispatch."""
+    global _PRELOADED
+    with _LOCK:
+        if _PRELOADED:
+            return len(_PROC)
+        t0 = time.perf_counter()
+        try:
+            names = sorted(os.listdir(cache_dir()))
+        except OSError:
+            names = []
+        for name in names:
+            if not (name.startswith("aot_v") and name.endswith(".npz")):
+                continue
+            if max_seconds is not None and time.perf_counter() - t0 > max_seconds:
+                break
+            path = os.path.join(cache_dir(), name)
+            try:
+                with np.load(path, allow_pickle=False) as z:
+                    key = ast.literal_eval(z["key"].tobytes().decode())
+            except Exception:
+                _STATS["disk_rejects"] += 1
+                REGISTRY.inc("aot_disk_rejects_total")
+                continue
+            if not (isinstance(key, tuple) and key and isinstance(key[0], str)):
+                _STATS["disk_rejects"] += 1
+                REGISTRY.inc("aot_disk_rejects_total")
+                continue
+            if key in _PROC:
+                continue
+            fn = _load_blob(path, key)
+            if fn is not None:
+                _PROC[key] = fn
+        _PRELOADED = True
+        REGISTRY.set_gauge("aot_resident_executables", len(_PROC))
+        return len(_PROC)
+
+
+def has_prefix(prefix: tuple) -> bool:
+    """True when some resident executable's key starts with ``prefix``
+    — lets warmup skip a bucket whose programs already loaded."""
+    with _LOCK:
+        return any(k[: len(prefix)] == prefix for k in _PROC)
+
+
+def note_error() -> None:
+    """Count one store failure (the caller degraded to its jit path)."""
+    with _LOCK:
+        _STATS["errors"] += 1
+    REGISTRY.inc("aot_errors_total")
+
+
+def stats() -> dict:
+    with _LOCK:
+        return dict(_STATS, resident=len(_PROC))
+
+
+def reset(clear_disk: bool = False) -> None:
+    """Forget process state (tests); optionally delete the store."""
+    global _PRELOADED, _DISK
+    with _LOCK:
+        _PROC.clear()
+        _PRELOADED = False
+        _DISK = None
+        for k in _STATS:
+            _STATS[k] = 0 if isinstance(_STATS[k], int) else 0.0
+        if clear_disk:
+            try:
+                for name in os.listdir(cache_dir()):
+                    if name.startswith("aot_v") and name.endswith(".npz"):
+                        os.unlink(os.path.join(cache_dir(), name))
+            except OSError:
+                pass

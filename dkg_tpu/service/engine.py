@@ -52,7 +52,7 @@ from ..fields import host as fh
 from ..groups import device as gd
 from ..groups import host as gh
 from ..groups import precompute as gp
-from . import buckets
+from . import aot, buckets
 from .errors import PoisonedRequest
 
 #: Default domain-separation string for service ceremonies (requests may
@@ -172,13 +172,106 @@ class WarmRuntime:
 
     def warmup(self, req: CeremonyRequest, widths: tuple = (1,)) -> None:
         """Compile the request's bucket programs ahead of traffic by
-        running one throwaway convoy per width (results discarded)."""
+        running one throwaway convoy per width (results discarded).
+
+        With the AOT store enabled (``DKG_TPU_AOT_DIR``), prebaked
+        executables deserialize into the process instead: the largest
+        requested width — the steady convoy shape — gets its
+        deal/verify pair preloaded eagerly, and any width whose deal
+        program is on disk skips its throwaway convoy entirely, leaving
+        the long tail (finalise, straggler widths, sign rungs) to lazy
+        dispatch-time loads.  Loads are seconds, compiles are minutes:
+        on a one-core host the store deserializes at ~5 MB/s, so eager
+        preloading everything would itself blow the warmup budget.  A
+        width missing from the store still runs its convoy (and, via
+        the dispatch seams, persists its executables for the next
+        process)."""
+        b = req.bucket()
+        if aot.enabled():
+            # tables + commitment key first: convoy-free warmup must
+            # leave the runtime as ready as the compiling path does
+            self.commitment(req.curve, req.shared_string)
+            w_hot = max(widths)
+            aot.preload_prefixes(
+                [
+                    ("deal", req.curve, b.n, b.t, w_hot),
+                    ("verify", req.curve, b.n, b.t, w_hot),
+                ]
+            )
         for w in widths:
+            if aot.enabled() and aot.disk_has_prefix(
+                ("deal", req.curve, b.n, b.t, w)
+            ):
+                continue
             reqs = [
                 dataclasses.replace(req, seed=(req.seed or 0) + i)
                 for i in range(w)
             ]
             finish_convoy(self, start_convoy(self, reqs))
+
+
+# ---------------------------------------------------------------------------
+# AOT executable dispatch
+# ---------------------------------------------------------------------------
+
+
+def _specs(args: tuple) -> tuple:
+    return tuple(
+        jax.tree_util.tree_map(
+            lambda leaf: jax.ShapeDtypeStruct(np.shape(leaf), leaf.dtype), a
+        )
+        for a in args
+    )
+
+
+def _aot_dispatch(key_prefix: tuple, args: tuple, lower, fallback):
+    """Serve one program dispatch from the AOT executable store when
+    it is enabled, else the ordinary jitted twin.  ``lower`` maps a
+    tuple of ShapeDtypeStruct specs to a ``jax.stages.Lowered`` (statics
+    baked in); the compiled result is persisted for every later process.
+    A store failure of any kind degrades to ``fallback`` — a request
+    must never die on a cache problem."""
+    if not aot.enabled():
+        return fallback()
+    try:
+        key = key_prefix + (aot.spec_sig(args),)
+        fn = aot.get_or_build(key, lambda: lower(_specs(args)).compile())
+        return fn(*args)
+    except Exception:
+        aot.note_error()
+        return fallback()
+
+
+def aot_sign_folded(curve: str, sigma_limbs: np.ndarray, h_dev):
+    """AOT twin of :func:`dkg_tpu.sign.partial.sign_folded`: same
+    broadcast semantics, same raw device result (pure uint32 limb math,
+    so the serialized ladder is bit-identical to the jit path), but the
+    rung executable comes from the store — a fresh worker's first sign
+    flush skips the ladder compile."""
+    from .. import sign as signing
+
+    if not aot.enabled():
+        return signing.sign_folded(curve, sigma_limbs, h_dev)
+    cs = gd.ALL_CURVES[curve]
+    hh = jnp.asarray(h_dev)
+    kk = jnp.asarray(sigma_limbs)
+    if kk.ndim == 1:
+        kk = jnp.broadcast_to(kk[None, :], (hh.shape[0], kk.shape[-1]))
+    args = (kk, hh)
+    return _aot_dispatch(
+        ("sign_folded", curve, int(hh.shape[0])),
+        args,
+        lambda sp: _sign_ladder.lower(cs, *sp),
+        lambda: signing.sign_folded(curve, sigma_limbs, h_dev),
+    )
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _sign_ladder(cs, kk, hh):
+    """Traced twin of the steady lane's folded ladder (scalar_mul's
+    eager entry inlines its core under trace; rung batches are already
+    power-of-two so the eager pad is a no-op)."""
+    return gd.scalar_mul(cs, kk, hh)
 
 
 # ---------------------------------------------------------------------------
@@ -330,14 +423,24 @@ def start_convoy(
         ca.append(pad_coeffs(a_real, b.n, b.t))
         cb.append(pad_coeffs(b_real, b.n, b.t))
     if len(reqs) == 1:
-        a, e, s, r = ce.deal(
-            cfg_pad, jnp.asarray(ca[0]), jnp.asarray(cb[0]), g_table, h_table
+        args = (jnp.asarray(ca[0]), jnp.asarray(cb[0]), g_table, h_table)
+        a, e, s, r = _aot_dispatch(
+            ("deal", req0.curve, b.n, b.t, 1, 0),
+            args,
+            lambda sp: ce.deal.lower(cfg_pad, *sp),
+            lambda: ce.deal(cfg_pad, *args),
         )
         a, e, s, r = a[None], e[None], s[None], r[None]
     else:
-        a, e, s, r = _deal_stack(
-            cfg_pad, jnp.asarray(np.stack(ca)), jnp.asarray(np.stack(cb)),
+        args = (
+            jnp.asarray(np.stack(ca)), jnp.asarray(np.stack(cb)),
             g_table, h_table,
+        )
+        a, e, s, r = _aot_dispatch(
+            ("deal", req0.curve, b.n, b.t, len(reqs), 0),
+            args,
+            lambda sp: _deal_stack.lower(cfg_pad, *sp),
+            lambda: _deal_stack(cfg_pad, *args),
         )
     if ids is None:
         ids = [request_id(req, i) for i, req in enumerate(reqs)]
@@ -356,15 +459,35 @@ def finish_convoy(runtime: WarmRuntime, fl: InFlight) -> list[CeremonyOutcome]:
     a_h, e_h = np.asarray(fl.a), np.asarray(fl.e)
     s_h, r_h = np.asarray(fl.s), np.asarray(fl.r)
     rho = derive_rho_convoy(cfg_pad, a_h, e_h, s_h, r_h, rho_bits)
+    curve = fl.reqs[0].curve
     if k == 1:
-        ok = ce.verify_batch(
-            cfg_pad, fl.e[0], fl.s[0], fl.r[0], jnp.asarray(rho[0]), rho_bits,
+        args = (
+            fl.e[0], fl.s[0], fl.r[0], jnp.asarray(rho[0]),
             fl.g_table, fl.h_table,
+        )
+        ok = _aot_dispatch(
+            ("verify", curve, n_pad, cfg_pad.t, 1, rho_bits),
+            args,
+            lambda sp: ce.verify_batch.lower(
+                cfg_pad, sp[0], sp[1], sp[2], sp[3], rho_bits, sp[4], sp[5]
+            ),
+            lambda: ce.verify_batch(
+                cfg_pad, args[0], args[1], args[2], args[3], rho_bits,
+                args[4], args[5],
+            ),
         )[None]
     else:
-        ok = _verify_stack(
-            cfg_pad, fl.e, fl.s, fl.r, jnp.asarray(rho), rho_bits,
-            fl.g_table, fl.h_table,
+        args = (fl.e, fl.s, fl.r, jnp.asarray(rho), fl.g_table, fl.h_table)
+        ok = _aot_dispatch(
+            ("verify", curve, n_pad, cfg_pad.t, k, rho_bits),
+            args,
+            lambda sp: _verify_stack.lower(
+                cfg_pad, sp[0], sp[1], sp[2], sp[3], rho_bits, sp[4], sp[5]
+            ),
+            lambda: _verify_stack(
+                cfg_pad, args[0], args[1], args[2], args[3], rho_bits,
+                args[4], args[5],
+            ),
         )
     ok_h = np.asarray(ok)
 
@@ -394,11 +517,25 @@ def finish_convoy(runtime: WarmRuntime, fl: InFlight) -> list[CeremonyOutcome]:
         # width-1 lanes reuse the plain executables (shared with
         # BatchedCeremony and the rest of the suite's compile cache)
         q0 = jnp.asarray(qualified[0])
-        final_shares = ce.aggregate_shares(cfg_pad, fl.s[0], q0)[None]
-        master = ce.master_key_from_bare(cfg_pad, fl.a[0], q0)[None]
+        final_shares = _aot_dispatch(
+            ("aggregate", curve, n_pad, cfg_pad.t, 1, 0),
+            (fl.s[0], q0),
+            lambda sp: ce.aggregate_shares.lower(cfg_pad, *sp),
+            lambda: ce.aggregate_shares(cfg_pad, fl.s[0], q0),
+        )[None]
+        master = _aot_dispatch(
+            ("master", curve, n_pad, cfg_pad.t, 1, 0),
+            (fl.a[0], q0),
+            lambda sp: ce.master_key_from_bare.lower(cfg_pad, *sp),
+            lambda: ce.master_key_from_bare(cfg_pad, fl.a[0], q0),
+        )[None]
     else:
-        final_shares, master = _finalise_stack(
-            cfg_pad, fl.a, fl.s, jnp.asarray(qualified)
+        qd = jnp.asarray(qualified)
+        final_shares, master = _aot_dispatch(
+            ("finalise", curve, n_pad, cfg_pad.t, k, 0),
+            (fl.a, fl.s, qd),
+            lambda sp: _finalise_stack.lower(cfg_pad, *sp),
+            lambda: _finalise_stack(cfg_pad, fl.a, fl.s, qd),
         )
     shares_h = np.asarray(final_shares)
     master_enc = gd.encode_batch(cfg_pad.cs, np.asarray(master))

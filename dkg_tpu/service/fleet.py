@@ -1,0 +1,629 @@
+"""Multi-process fleet front door: K scheduler workers behind one HTTP
+surface, with SLO-driven shedding and scaling.
+
+One warm process saturates at one device queue; "millions of users"
+means horizontal scale-out, which the AOT executable store
+(:mod:`~dkg_tpu.service.aot`) finally makes affordable — a fresh worker
+process deserializes its programs in seconds instead of recompiling for
+minutes.  This module is the control plane over those workers:
+
+* **Workers** — K child processes (stdlib ``multiprocessing``, spawn
+  start method so each child initializes its own JAX runtime), each
+  running one :class:`~dkg_tpu.service.scheduler.CeremonyScheduler`
+  over its own :class:`~dkg_tpu.service.engine.WarmRuntime`.  AOT
+  artifacts, fixed-base tables and compile caches are shared through
+  the on-disk stores (the environment — ``DKG_TPU_AOT_DIR`` included —
+  is inherited), so worker N+1 warms from worker 0's bake.  Parent and
+  child speak length-framed pickles over a ``Pipe``; one request, one
+  reply, serialized per worker by a parent-side lock.
+* **Routing** — requests land on a worker by their shape bucket
+  (BLAKE2b of ``(curve, bucket.n, bucket.t)`` mod alive workers), so a
+  bucket's convoys keep stacking inside one scheduler instead of
+  fragmenting across the fleet.
+* **Front door** — the :class:`~dkg_tpu.service.httpobs.ObsHttpServer`
+  scrape surface promoted to a real API via its ``router`` hook:
+  ``POST /submit``, ``GET /poll?cid=``, ``GET /result?cid=``,
+  ``POST /sign``, ``GET /fleet``, alongside the existing
+  ``/metrics`` ``/healthz`` ``/slo`` routes.  Queue-full and fleet
+  shedding both answer the existing 503 path.
+* **Control loop** — a parent thread samples every worker's
+  :meth:`~dkg_tpu.service.scheduler.CeremonyScheduler.slo_report` (PR
+  13's :class:`~dkg_tpu.service.slo.SloEvaluator`) and ``health()``:
+  error-budget burn or a p99 breach turns on load-shedding (new
+  submissions 503) and scales up toward ``k_max``; sustained idleness
+  (empty queues, objectives met, ``idle_rounds_down`` consecutive
+  samples) scales down toward ``k_min``.  Decisions are observable:
+  ``fleet_workers``, ``fleet_scale_total{direction}``,
+  ``fleet_shed_total``, ``fleet_requests_total{route}``.
+
+This module is deliberately **device-free**: it never imports jax, and
+lint rule DKG016 bans ``jax.jit`` tracing entry points here — every
+executable a request touches lives in a worker, loaded from the AOT
+store or compiled under the worker's ``WarmRuntime``.  DKG007
+sanctions this module (with scheduler/httpobs) as a service spawn
+site; the worker factory is injectable so tests drive routing, shed
+and scale decisions with in-process fakes in milliseconds.
+
+Knobs (all via utils.envknobs): ``DKG_TPU_FLEET_PROCS`` (initial K),
+``DKG_TPU_FLEET_MIN`` / ``DKG_TPU_FLEET_MAX`` (scale range),
+``DKG_TPU_FLEET_CONTROL_S`` (control-loop period),
+``DKG_TPU_FLEET_HTTP_PORT`` (front-door port; 0 = ephemeral, unset =
+python API only).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import multiprocessing
+import threading
+import time
+
+from ..utils import envknobs
+from ..utils.metrics import REGISTRY
+from . import buckets, errors
+from .httpobs import ObsHttpServer
+
+#: Per-op parent->worker reply budget (seconds) for control-plane ops.
+_CONTROL_TIMEOUT_S = 30.0
+
+
+class WorkerUnavailable(RuntimeError):
+    """The routed worker died or timed out mid-request."""
+
+
+def _outcome_wire(out) -> dict:
+    """JSON-able public view of a CeremonyOutcome — ``final_shares``
+    (secret) never crosses the pipe."""
+    return {
+        "ceremony_id": out.ceremony_id,
+        "status": out.status,
+        "curve": out.curve,
+        "n": out.n,
+        "t": out.t,
+        "bucket_n": out.bucket_n,
+        "bucket_t": out.bucket_t,
+        "master": out.master.hex(),
+        "qualified": list(out.qualified),
+        "complaints": [list(c) for c in out.complaints],
+        "error": out.error,
+        "seconds": out.seconds,
+        "epoch": out.epoch,
+    }
+
+
+def _proc_worker_main(conn, cfg: dict) -> None:
+    """Child entry: one WarmRuntime + one CeremonyScheduler, driven by
+    a request/reply loop over ``conn``.  Runs in a spawned process —
+    imports happen here, after the fork-free start."""
+    t0 = time.monotonic()
+    from . import aot as _aot
+    from . import engine as _engine
+    from .scheduler import CeremonyScheduler
+
+    runtime = _engine.WarmRuntime()
+    for w in cfg.get("warm", ()):
+        req = _engine.CeremonyRequest(
+            curve=w["curve"], n=w["n"], t=w["t"],
+            rho_bits=w.get("rho_bits", 128), seed=0,
+        )
+        runtime.warmup(req, widths=tuple(w.get("widths", (1,))))
+    sched = CeremonyScheduler(runtime=runtime, **cfg.get("scheduler", {}))
+    conn.send({"op": "ready", "warmup_s": time.monotonic() - t0})
+    while True:
+        try:
+            msg = conn.recv()
+        except (EOFError, OSError):
+            break
+        op = msg.get("op")
+        try:
+            if op == "submit":
+                req = _engine.CeremonyRequest(**msg["req"])
+                reply = {"ok": True, "cid": sched.submit(req)}
+            elif op == "poll":
+                reply = {"ok": True, "status": sched.poll(msg["cid"])}
+            elif op == "result":
+                out = sched.result(msg["cid"], timeout=msg.get("timeout"))
+                reply = {"ok": True, "outcome": _outcome_wire(out)}
+            elif op == "sign":
+                sigs = sched.sign(
+                    msg["cid"],
+                    [bytes.fromhex(m) for m in msg["msgs"]],
+                    prove=bool(msg.get("prove", False)),
+                    seed=msg.get("seed"),
+                )
+                reply = {"ok": True, "sigs": [s.hex() for s in sigs]}
+            elif op == "health":
+                reply = {"ok": True, "health": sched.health()}
+            elif op == "slo":
+                reply = {"ok": True, "slo": sched.slo_report()}
+            elif op == "stats":
+                reply = {"ok": True, "aot": _aot.stats()}
+            elif op == "close":
+                sched.close(drain=bool(msg.get("drain", True)))
+                conn.send({"ok": True})
+                break
+            else:
+                reply = {"ok": False, "error": f"unknown op {op!r}"}
+        except errors.QueueFullError as exc:
+            reply = {"ok": False, "error": "queue_full", "detail": str(exc)}
+        except Exception as exc:  # worker must answer, never die silent
+            REGISTRY.inc("fleet_worker_errors_total")
+            reply = {"ok": False, "error": type(exc).__name__, "detail": str(exc)}
+        try:
+            conn.send(reply)
+        except (BrokenPipeError, OSError):
+            break
+
+
+class _ProcWorker:
+    """Parent-side handle for one spawned scheduler process."""
+
+    def __init__(self, index: int, cfg: dict) -> None:
+        self.index = index
+        self.warmup_s: float | None = None
+        self._lock = threading.Lock()
+        ctx = multiprocessing.get_context("spawn")
+        self._conn, child = ctx.Pipe(duplex=True)
+        self._proc = ctx.Process(
+            target=_proc_worker_main,
+            args=(child, cfg),
+            name=f"dkg-fleet-{index}",
+            daemon=True,
+        )
+        self._proc.start()
+        child.close()
+
+    def alive(self) -> bool:
+        return self._proc.is_alive()
+
+    def call(self, op: str, timeout: float | None = None, **kw) -> dict:
+        with self._lock:
+            try:
+                self._conn.send({"op": op, **kw})
+                while True:
+                    if timeout is not None and not self._conn.poll(timeout):
+                        raise WorkerUnavailable(
+                            f"worker {self.index}: no reply to {op!r} "
+                            f"within {timeout}s"
+                        )
+                    reply = self._conn.recv()
+                    # the ready banner may precede the first reply
+                    if isinstance(reply, dict) and reply.get("op") == "ready":
+                        self.warmup_s = reply["warmup_s"]
+                        continue
+                    return reply
+            except (EOFError, OSError, BrokenPipeError) as exc:
+                raise WorkerUnavailable(
+                    f"worker {self.index} died mid-{op}: {exc}"
+                ) from exc
+
+    def wait_ready(self, timeout: float) -> float | None:
+        """Block until the worker's ready banner (its warmup seconds),
+        or None on timeout/death."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while self.warmup_s is None:
+                left = deadline - time.monotonic()
+                if left <= 0 or not self._conn.poll(min(left, 0.25)):
+                    if time.monotonic() >= deadline or not self.alive():
+                        return None
+                    continue
+                try:
+                    reply = self._conn.recv()
+                except (EOFError, OSError):
+                    return None
+                if isinstance(reply, dict) and reply.get("op") == "ready":
+                    self.warmup_s = reply["warmup_s"]
+        return self.warmup_s
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        try:
+            if self.alive():
+                self.call("close", timeout=timeout, drain=drain)
+        except WorkerUnavailable:
+            pass
+        self._proc.join(timeout=5.0)
+        if self._proc.is_alive():
+            self._proc.terminate()
+            self._proc.join(timeout=5.0)
+        self._conn.close()
+
+
+class FleetServer:
+    """The fleet: worker pool + router + control loop + front door.
+
+    ``worker_factory(index) -> worker`` is injectable for tests; a
+    worker exposes ``call(op, timeout=, **kw)``, ``alive()``,
+    ``stop(drain=)``, ``index`` and ``warmup_s``.  The default factory
+    spawns :class:`_ProcWorker` processes configured with this fleet's
+    scheduler kwargs and warm list.
+    """
+
+    def __init__(
+        self,
+        *,
+        procs: int | None = None,
+        k_min: int | None = None,
+        k_max: int | None = None,
+        control_interval_s: float | None = None,
+        idle_rounds_down: int = 3,
+        http_port: int | None = None,
+        scheduler_kwargs: dict | None = None,
+        warm: list | None = None,
+        worker_factory=None,
+        metrics=REGISTRY,
+        op_timeout_s: float = 600.0,
+    ) -> None:
+        self.metrics = metrics
+        self.k_init = procs if procs is not None else (
+            envknobs.pos_int("DKG_TPU_FLEET_PROCS", "initial fleet worker count")
+            or 1
+        )
+        self.k_min = k_min if k_min is not None else (
+            envknobs.pos_int("DKG_TPU_FLEET_MIN", "fleet scale-down floor") or 1
+        )
+        self.k_max = k_max if k_max is not None else (
+            envknobs.pos_int("DKG_TPU_FLEET_MAX", "fleet scale-up ceiling")
+            or max(self.k_init, self.k_min)
+        )
+        if not (self.k_min <= self.k_init <= self.k_max):
+            raise ValueError(
+                f"fleet size range: need k_min <= procs <= k_max, got "
+                f"{self.k_min} <= {self.k_init} <= {self.k_max}"
+            )
+        if control_interval_s is None:
+            control_interval_s = envknobs.pos_float(
+                "DKG_TPU_FLEET_CONTROL_S", "fleet control-loop period"
+            )
+        self.control_interval_s = control_interval_s
+        self.idle_rounds_down = idle_rounds_down
+        self.op_timeout_s = op_timeout_s
+        self._cfg = {
+            "scheduler": dict(scheduler_kwargs or {}),
+            "warm": list(warm or ()),
+        }
+        self._factory = worker_factory or (
+            lambda idx: _ProcWorker(idx, self._cfg)
+        )
+        self._lock = threading.RLock()
+        self._workers: list = []
+        self._placed: dict[str, object] = {}
+        self._next_index = 0
+        self._shedding = False
+        self._idle_rounds = 0
+        self._closing = False
+        for _ in range(self.k_init):
+            self._spawn()
+        self._http = None
+        if http_port is None:
+            http_port = envknobs.nonneg_int(
+                "DKG_TPU_FLEET_HTTP_PORT",
+                "fleet front-door port (0 = ephemeral; unset = off)",
+            )
+        if http_port is not None:
+            self._http = ObsHttpServer(
+                registry=metrics,
+                health_fn=self.health,
+                slo_fn=self.slo_report,
+                router=self._route,
+                port=http_port,
+            )
+        self._control_thread = None
+        if control_interval_s:
+            self._control_thread = threading.Thread(
+                target=self._control_loop, name="dkg-fleet-control", daemon=True
+            )
+            self._control_thread.start()
+
+    # -- worker pool ---------------------------------------------------------
+
+    def _spawn(self):
+        w = self._factory(self._next_index)
+        self._next_index += 1
+        self._workers.append(w)
+        self.metrics.set_gauge("fleet_workers", len(self._workers))
+        return w
+
+    def _alive(self) -> list:
+        return [w for w in self._workers if w.alive()]
+
+    def wait_ready(self, timeout: float = 600.0) -> list:
+        """Block until every current worker reported its warmup banner;
+        returns their warmup seconds (None per straggler)."""
+        with self._lock:
+            ws = list(self._workers)
+        out = []
+        deadline = time.monotonic() + timeout
+        for w in ws:
+            left = max(deadline - time.monotonic(), 0.0)
+            out.append(
+                w.wait_ready(left) if hasattr(w, "wait_ready") else w.warmup_s
+            )
+        return out
+
+    # -- data plane ----------------------------------------------------------
+
+    def _worker_for(self, curve: str, n: int, t: int):
+        b = buckets.bucket_for(n, t)
+        with self._lock:
+            ws = self._alive()
+            if not ws:
+                raise errors.QueueFullError("fleet has no live workers")
+            tag = hashlib.blake2b(
+                f"{curve}:{b.n}:{b.t}".encode(), digest_size=4
+            ).digest()
+            return ws[int.from_bytes(tag, "big") % len(ws)]
+
+    def submit(self, req: dict) -> str:
+        """Route one ceremony request (JSON-able dict of
+        CeremonyRequest fields) to its bucket's worker.  Raises
+        QueueFullError on shed/full (the HTTP 503 path) and ValueError
+        on a malformed request."""
+        with self._lock:
+            if self._shedding:
+                self.metrics.inc("fleet_shed_total")
+                raise errors.QueueFullError(
+                    "fleet is shedding load (SLO breach)"
+                )
+        try:
+            curve, n, t = req["curve"], int(req["n"]), int(req["t"])
+        except (KeyError, TypeError, ValueError) as exc:
+            raise ValueError(f"submit needs curve/n/t: {exc}") from exc
+        w = self._worker_for(curve, n, t)
+        try:
+            reply = w.call("submit", req=dict(req), timeout=self.op_timeout_s)
+        except WorkerUnavailable as exc:
+            self.metrics.inc("fleet_worker_errors_total")
+            raise errors.QueueFullError(str(exc)) from exc
+        if not reply.get("ok"):
+            if reply.get("error") == "queue_full":
+                self.metrics.inc("fleet_shed_total")
+                raise errors.QueueFullError(reply.get("detail", "queue full"))
+            raise ValueError(reply.get("detail") or reply.get("error", "submit failed"))
+        cid = reply["cid"]
+        with self._lock:
+            self._placed[cid] = w
+        return cid
+
+    def _placed_worker(self, cid: str):
+        with self._lock:
+            return self._placed.get(cid)
+
+    def poll(self, cid: str) -> str:
+        w = self._placed_worker(cid)
+        if w is None or not w.alive():
+            return "unknown"
+        reply = w.call("poll", cid=cid, timeout=self.op_timeout_s)
+        return reply.get("status", "unknown") if reply.get("ok") else "unknown"
+
+    def result(self, cid: str, timeout: float | None = None) -> dict:
+        w = self._placed_worker(cid)
+        if w is None:
+            raise KeyError(f"unknown ceremony {cid!r}")
+        budget = timeout if timeout is not None else self.op_timeout_s
+        reply = w.call("result", cid=cid, timeout=budget + 10.0)
+        if not reply.get("ok"):
+            raise errors.ServiceError(reply.get("detail") or reply.get("error"))
+        return reply["outcome"]
+
+    def sign(self, cid: str, msgs: list[bytes], **kw) -> list[bytes]:
+        w = self._placed_worker(cid)
+        if w is None:
+            raise KeyError(f"unknown ceremony {cid!r}")
+        reply = w.call(
+            "sign", cid=cid, msgs=[m.hex() for m in msgs],
+            timeout=self.op_timeout_s, **kw,
+        )
+        if not reply.get("ok"):
+            raise errors.ServiceError(reply.get("detail") or reply.get("error"))
+        return [bytes.fromhex(s) for s in reply["sigs"]]
+
+    # -- observability + control plane ---------------------------------------
+
+    def health(self) -> dict:
+        with self._lock:
+            ws = list(self._workers)
+            shedding = self._shedding
+        per = []
+        for w in ws:
+            if not w.alive():
+                per.append({"worker": w.index, "ok": False, "alive": False})
+                continue
+            try:
+                h = w.call("health", timeout=_CONTROL_TIMEOUT_S)
+                per.append(
+                    {"worker": w.index, "alive": True, **h.get("health", {})}
+                )
+            except WorkerUnavailable:
+                per.append({"worker": w.index, "ok": False, "alive": False})
+        alive = [p for p in per if p.get("alive")]
+        return {
+            "ok": bool(alive) and not shedding,
+            "shedding": shedding,
+            "workers_alive": len(alive),
+            "workers_total": len(per),
+            "workers": per,
+        }
+
+    def slo_report(self) -> dict:
+        with self._lock:
+            ws = self._alive()
+        per = []
+        for w in ws:
+            try:
+                r = w.call("slo", timeout=_CONTROL_TIMEOUT_S)
+                if r.get("ok"):
+                    per.append({"worker": w.index, **r["slo"]})
+            except WorkerUnavailable:
+                continue
+        violations = [
+            v for r in per for v in r.get("violations", ())
+        ]
+        return {
+            "ok": all(r.get("ok", True) for r in per),
+            "violations": violations,
+            "workers": per,
+        }
+
+    def describe(self) -> dict:
+        with self._lock:
+            return {
+                "workers": len(self._workers),
+                "alive": len(self._alive()),
+                "k_min": self.k_min,
+                "k_max": self.k_max,
+                "shedding": self._shedding,
+                "warmup_s": [w.warmup_s for w in self._workers],
+                "placed": len(self._placed),
+            }
+
+    def _control_once(self) -> dict:
+        """One SLO-driven control decision; called by the loop thread
+        and directly by tests.  Returns the decision record."""
+        with self._lock:
+            ws = list(self._workers)
+            # reap workers that died (crash, OOM-kill): routing already
+            # skips them, this trims the pool and frees the pipe
+            dead = [w for w in ws if not w.alive()]
+            for w in dead:
+                self._workers.remove(w)
+                self.metrics.inc("fleet_worker_restarts_total")
+            # keep the pool at the floor: a crashed worker is replaced
+            # even in a healthy window
+            while len(self._workers) < self.k_min and not self._closing:
+                self._spawn()
+            ws = list(self._workers)
+        reports, healths = [], []
+        for w in ws:
+            try:
+                r = w.call("slo", timeout=_CONTROL_TIMEOUT_S)
+                h = w.call("health", timeout=_CONTROL_TIMEOUT_S)
+            except WorkerUnavailable:
+                continue
+            if r.get("ok"):
+                reports.append(r["slo"])
+            if h.get("ok"):
+                healths.append(h["health"])
+        breach = any(not r.get("ok", True) for r in reports)
+        burn = 0.0
+        for r in reports:
+            err = r.get("errors") or {}
+            burn = max(burn, float(err.get("burn") or 0.0))
+        depth = sum(int(h.get("queue_depth", 0)) for h in healths)
+        decision = "hold"
+        with self._lock:
+            alive = len(self._alive())
+            if breach or burn > 1.0:
+                self._shedding = True
+                self._idle_rounds = 0
+                if alive < self.k_max and not self._closing:
+                    self._spawn()
+                    decision = "up"
+                    self.metrics.inc("fleet_scale_total", direction="up")
+            else:
+                self._shedding = False
+                if depth == 0 and reports:
+                    self._idle_rounds += 1
+                else:
+                    self._idle_rounds = 0
+                if (
+                    self._idle_rounds >= self.idle_rounds_down
+                    and alive > self.k_min
+                    and not self._closing
+                ):
+                    victim = self._workers.pop()
+                    decision = "down"
+                    self._idle_rounds = 0
+                    self.metrics.inc("fleet_scale_total", direction="down")
+                else:
+                    victim = None
+            self.metrics.set_gauge("fleet_workers", len(self._workers))
+            self.metrics.set_gauge("fleet_shedding", 1.0 if self._shedding else 0.0)
+        if decision == "down":
+            victim.stop(drain=True)
+        return {
+            "decision": decision,
+            "shedding": self._shedding,
+            "breach": breach,
+            "burn": burn,
+            "queue_depth": depth,
+            "workers": len(ws),
+        }
+
+    def _control_loop(self) -> None:
+        while not self._closing:
+            time.sleep(self.control_interval_s)
+            if self._closing:
+                return
+            try:
+                self._control_once()
+            except Exception:
+                self.metrics.inc("fleet_control_errors_total")
+
+    # -- HTTP front door -----------------------------------------------------
+
+    def _route(self, method: str, path: str, query: dict, body):
+        if method == "POST" and path == "/submit":
+            self.metrics.inc("fleet_requests_total", route="submit")
+            try:
+                cid = self.submit(body or {})
+                return 200, {"ceremony_id": cid}
+            except errors.QueueFullError as exc:
+                return 503, {"error": "unavailable", "detail": str(exc)}
+            except (TypeError, ValueError) as exc:
+                return 400, {"error": "bad request", "detail": str(exc)}
+        if method == "GET" and path == "/poll":
+            self.metrics.inc("fleet_requests_total", route="poll")
+            cid = query.get("cid", "")
+            return 200, {"ceremony_id": cid, "status": self.poll(cid)}
+        if method == "GET" and path == "/result":
+            self.metrics.inc("fleet_requests_total", route="result")
+            cid = query.get("cid", "")
+            try:
+                timeout = float(query["timeout"]) if "timeout" in query else None
+                return 200, self.result(cid, timeout=timeout)
+            except KeyError:
+                return 404, {"error": "unknown ceremony", "ceremony_id": cid}
+            except (RuntimeError, ValueError) as exc:
+                return 409, {"error": str(exc), "ceremony_id": cid}
+        if method == "POST" and path == "/sign":
+            self.metrics.inc("fleet_requests_total", route="sign")
+            body = body or {}
+            cid = body.get("cid", "")
+            try:
+                msgs = [bytes.fromhex(m) for m in body.get("msgs", [])]
+                sigs = self.sign(
+                    cid, msgs,
+                    prove=bool(body.get("prove", False)),
+                    seed=body.get("seed"),
+                )
+                return 200, {
+                    "ceremony_id": cid,
+                    "signatures": [s.hex() for s in sigs],
+                }
+            except KeyError:
+                return 404, {"error": "unknown ceremony", "ceremony_id": cid}
+            except (RuntimeError, ValueError) as exc:
+                return 409, {"error": str(exc), "ceremony_id": cid}
+        if method == "GET" and path == "/fleet":
+            self.metrics.inc("fleet_requests_total", route="fleet")
+            return 200, self.describe()
+        return None
+
+    @property
+    def port(self) -> int | None:
+        return self._http.port if self._http is not None else None
+
+    def close(self, drain: bool = True) -> None:
+        self._closing = True
+        if self._control_thread is not None:
+            self._control_thread.join(
+                timeout=(self.control_interval_s or 0) + 5.0
+            )
+        if self._http is not None:
+            self._http.close()
+        with self._lock:
+            ws = list(self._workers)
+            self._workers.clear()
+        for w in ws:
+            w.stop(drain=drain)

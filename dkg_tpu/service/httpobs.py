@@ -25,8 +25,15 @@ material cannot transit this surface; tests/test_runtimeobs.py greps
 the responses for the obslog redaction contract.
 
 The handler thread is spawned here rather than in scheduler.py; lint
-DKG007 sanctions exactly this module and the scheduler as service
-thread-spawn sites.
+DKG007 sanctions exactly this module, the scheduler and the fleet as
+service thread/process-spawn sites.
+
+**Front-door promotion.**  :mod:`~dkg_tpu.service.fleet` reuses this
+server as a real request surface: the optional ``router`` callback
+receives ``(method, path, query, body)`` for any request the scrape
+routes don't claim and returns ``(status, payload)`` — POST bodies are
+parsed as JSON here so route owners never touch the socket.  The scrape
+surface semantics above are unchanged when no router is installed.
 """
 
 from __future__ import annotations
@@ -34,6 +41,7 @@ from __future__ import annotations
 import http.server
 import json
 import threading
+import urllib.parse
 
 from ..utils import envknobs
 from ..utils.metrics import REGISTRY
@@ -50,6 +58,13 @@ class ObsHttpServer:
     be None, which 404s that route.  A callback that raises is recorded
     (``service_http_errors_total``) and answered 500 — a broken probe
     must read as unhealthy, not kill the serve thread.
+
+    ``router`` (the fleet front door) is consulted for any GET the
+    scrape routes don't claim and for every POST:
+    ``router(method, path, query, body) -> (status, payload) | None``,
+    with ``query`` a flat str->str dict and ``body`` the parsed JSON
+    object of a POST (None for GETs / empty bodies).  ``None`` falls
+    through to 404; exceptions follow the 500-and-count contract above.
     """
 
     def __init__(
@@ -58,6 +73,7 @@ class ObsHttpServer:
         registry=None,
         health_fn=None,
         slo_fn=None,
+        router=None,
         log=None,
         port: int = 0,
         host: str = "127.0.0.1",
@@ -65,6 +81,7 @@ class ObsHttpServer:
         self.registry = registry if registry is not None else REGISTRY
         self.health_fn = health_fn
         self.slo_fn = slo_fn
+        self.router = router
         self.log = log
         server = self
 
@@ -88,6 +105,25 @@ class ObsHttpServer:
                     "application/json",
                 )
 
+            def _query(self) -> dict:
+                raw = self.path.split("?", 1)
+                if len(raw) < 2:
+                    return {}
+                return {
+                    k: v[-1]
+                    for k, v in urllib.parse.parse_qs(raw[1]).items()
+                }
+
+            def _route(self, method: str, path: str, body) -> None:
+                if server.router is None:
+                    self._send_json(404, {"error": "not found", "path": path})
+                    return
+                routed = server.router(method, path, self._query(), body)
+                if routed is None:
+                    self._send_json(404, {"error": "not found", "path": path})
+                    return
+                self._send_json(routed[0], routed[1])
+
             def do_GET(self):  # noqa: N802 (BaseHTTPRequestHandler API)
                 path = self.path.split("?", 1)[0]
                 try:
@@ -106,13 +142,32 @@ class ObsHttpServer:
                         report = server.slo_fn()
                         self._send_json(200 if report.get("ok") else 503, report)
                     else:
-                        self._send_json(404, {"error": "not found", "path": path})
+                        self._route("GET", path, None)
                 except Exception as exc:
                     server._note(path, exc)
                     try:
                         self._send_json(500, {"error": type(exc).__name__})
                     except Exception as exc2:
                         # client already gone mid-response; count it too
+                        server._note(path, exc2)
+
+            def do_POST(self):  # noqa: N802 (BaseHTTPRequestHandler API)
+                path = self.path.split("?", 1)[0]
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                    raw = self.rfile.read(length) if length else b""
+                    body = json.loads(raw) if raw else None
+                    if body is not None and not isinstance(body, dict):
+                        self._send_json(400, {"error": "body must be a JSON object"})
+                        return
+                    self._route("POST", path, body)
+                except json.JSONDecodeError:
+                    self._send_json(400, {"error": "invalid JSON body"})
+                except Exception as exc:
+                    server._note(path, exc)
+                    try:
+                        self._send_json(500, {"error": type(exc).__name__})
+                    except Exception as exc2:
                         server._note(path, exc2)
 
         self._httpd = http.server.ThreadingHTTPServer((host, port), _Handler)

@@ -103,6 +103,7 @@ from .engine import (
     CeremonyOutcome,
     CeremonyRequest,
     WarmRuntime,
+    aot_sign_folded,
     finish_convoy,
     request_id,
     start_convoy,
@@ -1080,7 +1081,11 @@ class CeremonyScheduler:
                     signmesh.sign_folded_sharded(curve, rows[a:b], h_dev, mesh)
                 )
             else:
-                pending.append(signing.sign_folded(curve, rows[a:b], h_dev))
+                # AOT-aware twin: bit-identical to sign_folded, but the
+                # rung executable deserializes from the store when
+                # DKG_TPU_AOT_DIR is set (fresh workers skip the
+                # ladder compile)
+                pending.append(aot_sign_folded(curve, rows[a:b], h_dev))
             t_partial += time.monotonic() - tp0
         ta0 = time.monotonic()
         wire = signing.signature_encode(

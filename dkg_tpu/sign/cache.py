@@ -55,6 +55,15 @@ from ..groups import host as gh
 from ..poly import host as ph
 
 
+def sigma_limb_count(curve: str) -> int:
+    """Limb count of one folded-sigma row — the trailing dimension of
+    the ``(B, L)`` rows :meth:`SignCache.fold_limbs` feeds the steady
+    lane's ladder.  The AOT prebake (``scripts/aot_build.py``)
+    synthesizes rung-shaped dummy rows from it so a fresh worker's
+    sign-rung executables are already on disk."""
+    return gh.ALL_GROUPS[curve].scalar_field.limbs
+
+
 class CeremonyMaterial:
     """Everything quorum-stable about one (ceremony, epoch): the decoded
     share vector plus lazily-built per-quorum public keys and the folded

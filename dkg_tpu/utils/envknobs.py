@@ -52,7 +52,18 @@ any platform, 0 skips it; read by the driver scripts, not dkg_tpu/),
 DKG_TPU_EPOCH_MAX_CHURN (leave+join budget a reshare accepts; 0
 refuses any membership change) and DKG_TPU_EPOCH_DEADLINE_S
 (per-epoch-round fetch timeout) via dkg_tpu.epoch.manager — lint
-rule DKG008 likewise bans raw environment access in dkg_tpu/epoch/).
+rule DKG008 likewise bans raw environment access in dkg_tpu/epoch/,
+DKG_TPU_AOT_DIR (AOT-serialized executable store directory; unset
+keeps the store off) via service.aot — also read by scripts/aot_lab.py
+as its compile-cache location,
+DKG_TPU_AOT_TOPOLOGY (chip-less topology scripts/aot_lab.py compiles
+against, default v5e:2x2),
+DKG_TPU_FLEET_PROCS (initial worker-process count) /
+DKG_TPU_FLEET_MIN / DKG_TPU_FLEET_MAX (autoscale floor/ceiling) /
+DKG_TPU_FLEET_CONTROL_S (control-loop period; unset disables the
+loop) / DKG_TPU_FLEET_HTTP_PORT (front-door port; 0 binds an
+ephemeral port, unset keeps the fleet python-API only) via
+service.fleet).
 
 An EMPTY value is everywhere treated as unset: ``DKG_TPU_X= cmd`` is
 the shell idiom for clearing a knob on one invocation, and must select

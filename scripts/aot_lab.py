@@ -15,6 +15,13 @@ Usage (CPU env — the axon plugin must NOT load):
 
     PYTHONPATH=/root/repo JAX_PLATFORMS=cpu python scripts/aot_lab.py [n t curve]
 
+Knobs (utils.envknobs): ``DKG_TPU_AOT_DIR`` points the lab's compile
+cache at the AOT store directory (so the lab and the serving store
+land together; default ``/tmp/dkg_tpu_jax_cache_aot``),
+``DKG_TPU_AOT_TOPOLOGY`` picks the chip-less topology to compile for
+(default ``v5e:2x2``), ``DKG_TPU_ASSUME_BACKEND`` the flag-resolution
+backend, ``DKG_TPU_FB_WINDOW`` the fixed-base window.
+
 Prints one JSON line per compiled phase with memory analysis.
 """
 
@@ -30,17 +37,28 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 # Compile-only: the axon plugin must be absent (see SKILL.md); force it
 # off for child-proofing but do NOT re-exec (caller sets the env).
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from dkg_tpu.utils import envknobs  # noqa: E402
+
 # Resolve every backend-sensitive dispatch (fused kernels, MXU, table
 # width, RLC schedule) as if on the chip, so the compiled program is
 # the one the chip actually runs.  Override with DKG_TPU_ASSUME_BACKEND=cpu
 # to model the conservative flag set.
-if not os.environ.get("DKG_TPU_ASSUME_BACKEND"):  # unset OR empty
+if not envknobs.choice(
+    "DKG_TPU_ASSUME_BACKEND", ("cpu", "tpu"), "flag-resolution backend"
+):
     os.environ["DKG_TPU_ASSUME_BACKEND"] = "tpu"
 
 import jax
 import jax.numpy as jnp
 
-jax.config.update("jax_compilation_cache_dir", "/tmp/dkg_tpu_jax_cache_aot")
+# Compile cache beside the AOT executable store when one is configured
+# (scripts/aot_build.py --validate runs this lab against the same dir).
+jax.config.update(
+    "jax_compilation_cache_dir",
+    envknobs.string("DKG_TPU_AOT_DIR", "AOT executable store directory")
+    or "/tmp/dkg_tpu_jax_cache_aot",
+)
 
 from jax.experimental import topologies as jtop
 
@@ -49,13 +67,17 @@ from dkg_tpu.dkg import ceremony as ce
 N = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
 T = int(sys.argv[2]) if len(sys.argv) > 2 else 1365
 CURVE = sys.argv[3] if len(sys.argv) > 3 else "secp256k1"
-WINDOW = int(os.environ.get("DKG_TPU_FB_WINDOW", "16"))
+WINDOW = envknobs.pos_int("DKG_TPU_FB_WINDOW", "fixed-base window bits") or 16
+TOPOLOGY = (
+    envknobs.string("DKG_TPU_AOT_TOPOLOGY", "chip-less AOT compile topology")
+    or "v5e:2x2"
+)
 RHO_BITS = 128
 
 # v5e:1x1 is rejected by the default 2x2x1 chips_per_host_bounds, so
-# describe the smallest valid slice (2x2) and compile for ONE of its
-# devices — the executable is single-device either way.
-topo = jtop.get_topology_desc("v5e:2x2", "tpu")
+# the default describes the smallest valid slice (2x2) and compiles for
+# ONE of its devices — the executable is single-device either way.
+topo = jtop.get_topology_desc(TOPOLOGY, "tpu")
 dev = topo.devices[0]
 from jax.sharding import SingleDeviceSharding
 

@@ -11,6 +11,13 @@ pre-service serial-loop shape on the SAME workload:
   pre-service loop: concurrency 1, batch_max 1 (one ceremony at a time
   through the plain width-1 executables, exactly what a caller looping
   over ``BatchedCeremony`` pays).
+* **fleet leg** (``--procs``) — the multi-process front door
+  (dkg_tpu.service.fleet): K spawned scheduler workers against the
+  shared AOT executable store, measuring process-spawn-to-first-ceremony
+  (``fleet.first_ceremony_s``), per-worker warmup, and per-proc
+  throughput across fleet sizes.  Run with ``DKG_TPU_AOT_DIR`` pointing
+  at a store baked by ``scripts/aot_build.py`` — without it every worker
+  recompiles from scratch and the leg takes minutes per worker.
 
 The workload mixes committee sizes n=16..64 (small-heavy, as service
 traffic is) with thresholds chosen so the mix lands on three buckets —
@@ -131,18 +138,129 @@ def wire_mix(curve: str, reqs) -> dict:
 
 
 def warmup(runtime: engine.WarmRuntime, reqs, widths) -> float:
-    """Compile every (bucket, width) program the legs will need; returns
-    seconds spent (compiles + first table builds)."""
+    """Make every (bucket, width) program the legs will need servable;
+    returns seconds spent.  Without the AOT store that means compiles +
+    first table builds; with ``DKG_TPU_AOT_DIR`` pointing at a baked
+    store (scripts/aot_build.py) the bucket's hot convoy shape
+    deserializes instead and the rest is skipped to lazy dispatch-time
+    loads — one warmup call per bucket with the full width tuple, so
+    engine.WarmRuntime.warmup eagerly preloads only the largest width."""
     t0 = time.perf_counter()
     by_bucket = {}
     for r in reqs:
         by_bucket.setdefault(r.bucket(), r)
     for b, req in sorted(by_bucket.items(), key=lambda kv: kv[0].n):
         cap = buckets.width_cap(b)
-        for w in sorted({min(w, cap) for w in widths}, reverse=True):
-            print(f"fleet_bench: warmup bucket ({b.n},{b.t}) width {w}", flush=True)
-            runtime.warmup(req, widths=(w,))
+        ws = tuple(sorted({min(w, cap) for w in widths}, reverse=True))
+        print(f"fleet_bench: warmup bucket ({b.n},{b.t}) widths {ws}", flush=True)
+        runtime.warmup(req, widths=ws)
     return time.perf_counter() - t0
+
+
+def _req_wire(r: engine.CeremonyRequest) -> dict:
+    """The JSON-able request dict the fleet front door accepts."""
+    return {
+        "curve": r.curve, "n": r.n, "t": r.t,
+        "seed": r.seed, "rho_bits": r.rho_bits,
+    }
+
+
+def build_fleet_workload(curve: str, per_bucket: int, rho_bits: int, seed: int):
+    """Bucket-BALANCED workload for the multi-process leg: the fleet
+    routes by bucket hash, so equal per-bucket counts spread work across
+    workers (the service-leg MIX is 90% one bucket and would pin a
+    single worker)."""
+    reqs = []
+    for i, (n, t) in enumerate(((16, 5), (24, 8), (48, 16))):
+        for j in range(per_bucket):
+            reqs.append(
+                engine.CeremonyRequest(
+                    curve, n, t,
+                    seed=seed * 2_000_000 + i * 10_000 + j,
+                    rho_bits=rho_bits,
+                )
+            )
+    random.Random(seed).shuffle(reqs)
+    return reqs
+
+
+def run_fleet_leg(args, procs: int, reqs) -> dict:
+    """One multi-process fleet size: spawn ``procs`` workers against the
+    shared AOT store, measure process-start-to-first-ceremony, per-worker
+    warmup, and drained throughput.  Width-1 singles (concurrency 1,
+    batch_max 1) keep the leg's programs to the store's smallest set so
+    the leg measures fleet scale-out, not convoy stacking (the service
+    leg above already measures that)."""
+    from dkg_tpu.service.fleet import FleetServer
+
+    by_bucket = {}
+    for r in reqs:
+        by_bucket.setdefault(r.bucket(), r)
+    warm = [
+        {"curve": r.curve, "n": r.n, "t": r.t,
+         "rho_bits": r.rho_bits, "widths": (1,)}
+        for _, r in sorted(by_bucket.items(), key=lambda kv: kv[0].n)
+    ]
+    t_start = time.monotonic()
+    fleet = FleetServer(
+        procs=procs, k_min=procs, k_max=procs,
+        control_interval_s=None,
+        scheduler_kwargs=dict(
+            concurrency=1, queue_depth=len(reqs) + 8, batch_max=1
+        ),
+        warm=warm,
+    )
+    # first ceremony submitted BEFORE any worker is warm: this measures
+    # the cold start end to end — process spawn + backend init + AOT
+    # deserializes + the ceremony itself
+    cid0 = fleet.submit(_req_wire(reqs[0]))
+    out0 = fleet.result(cid0, timeout=1800)
+    first_s = time.monotonic() - t_start
+    warmups = fleet.wait_ready(timeout=1800)
+    t0 = time.monotonic()
+    cids = [fleet.submit(_req_wire(r)) for r in reqs[1:]]
+    outs = [fleet.result(c, timeout=1800) for c in cids]
+    total = time.monotonic() - t0
+    all_outs = [out0] + outs
+    done = sum(1 for o in all_outs if o.get("status") == "done")
+    # masters bit-identical to fresh unpadded single runs, one per bucket
+    sample, seen = [], set()
+    for r, o in zip(reqs, all_outs):
+        b = r.bucket()
+        if b not in seen:
+            seen.add(b)
+            sample.append((r, o))
+    mismatches = [
+        {"n": r.n, "t": r.t, "seed": r.seed}
+        for r, o in sample
+        if o.get("master") != engine.run_single_reference(r).hex()
+    ]
+    workers = fleet.describe()
+    fleet.close()
+    leg = {
+        "procs": procs,
+        "ceremonies": len(all_outs),
+        "completed": done,
+        "first_ceremony_s": round(first_s, 2),
+        "worker_warmup_s": [
+            round(w, 2) if isinstance(w, (int, float)) else w for w in warmups
+        ],
+        "total_s": round(total, 3),
+        "ceremonies_per_s": round(len(outs) / total, 3),
+        "per_proc_ceremonies_per_s": round(len(outs) / total / procs, 3),
+        "masters_match": not mismatches,
+        "placed": workers["placed"],
+    }
+    if mismatches:
+        leg["mismatches"] = mismatches
+    print(
+        f"fleet_bench: fleet procs={procs}: first ceremony {leg['first_ceremony_s']}s "
+        f"after spawn, warmups {leg['worker_warmup_s']}, "
+        f"{leg['ceremonies_per_s']}/s ({leg['per_proc_ceremonies_per_s']}/s/proc), "
+        f"masters_match={leg['masters_match']}",
+        flush=True,
+    )
+    return leg
 
 
 def run_leg(
@@ -244,6 +362,17 @@ def main(argv=None) -> int:
         help="comma-separated convoy widths to precompile "
         "(default: batch_max and 1)",
     )
+    ap.add_argument(
+        "--procs", default=None,
+        help="also run the multi-process fleet leg at these worker "
+        "counts (comma-separated, e.g. '1,2'; a single K measures 1 "
+        "and K so scaling is always a comparison)",
+    )
+    ap.add_argument(
+        "--fleet-ceremonies", type=int, default=36,
+        help="ceremonies per fleet size in the --procs leg "
+        "(bucket-balanced, so they spread across workers)",
+    )
     ap.add_argument("--out", default="FLEET_r01.json")
     args = ap.parse_args(argv)
 
@@ -311,15 +440,49 @@ def main(argv=None) -> int:
         )
         print(f"fleet_bench: speedup {report['speedup']}x", flush=True)
 
+    from dkg_tpu.service import aot  # noqa: E402 (after jax env setup)
+
+    if aot.enabled():
+        report["aot"] = aot.stats()
+    fleet_ok = True
+    if args.procs:
+        sizes = sorted({int(k) for k in str(args.procs).split(",")} | {1})
+        fleet_reqs = build_fleet_workload(
+            args.curve, max(1, args.fleet_ceremonies // 3),
+            args.rho_bits, args.seed + 7,
+        )
+        legs = [run_fleet_leg(args, k, fleet_reqs) for k in sizes]
+        report["fleet"] = {
+            "sizes": legs,
+            # first_ceremony_s definition, for readers of the JSON:
+            # process spawn -> first ceremony result, measured on a
+            # submission made before any worker finished warming
+            "first_ceremony_s": min(l["first_ceremony_s"] for l in legs),
+            "scaling_note": (
+                "per-proc ceremonies/s on "
+                f"{os.cpu_count()} core(s): with fewer cores than "
+                "workers the processes time-slice one CPU, so total "
+                "throughput stays ~flat and per-proc falls ~1/K; on a "
+                "multi-core host the same fleet multiplies throughput "
+                "until cores or the device saturate"
+            ),
+        }
+        fleet_ok = all(
+            l["masters_match"] and l["completed"] == l["ceremonies"]
+            for l in legs
+        )
+
     # taken last so the block covers warmup AND both measured legs (a
     # warm rerun shows compiles_total collapsing toward zero here)
     runtimeobs.sample_memory()
     report["runtime"] = runtimeobs.snapshot()
     pathlib.Path(args.out).write_text(json.dumps(report, indent=1) + "\n")
     print(f"fleet_bench: wrote {args.out}", flush=True)
-    ok = report["verify"]["masters_match"] and service["statuses"].get(
-        "done"
-    ) == len(reqs)
+    ok = (
+        report["verify"]["masters_match"]
+        and service["statuses"].get("done") == len(reqs)
+        and fleet_ok
+    )
     return 0 if ok else 1
 
 

@@ -134,6 +134,14 @@ linter), so the committed baseline stays clean between CI runs:
         (``make_mesh``/``sign_mesh``) so axis names, check-kwarg
         compatibility, and placement policy cannot fork per module
         (docs/perf.md "Sharded ceremony")
+* DKG016  (dkg_tpu/service/fleet.py only) any ``jax`` import: the fleet
+        control plane is device-free by design — a ``jax.jit`` tracing
+        entry point in the front door's request path would recreate the
+        per-process cold start the AOT store exists to kill, and would
+        initialize a JAX runtime in the parent that every spawned
+        worker then re-initializes.  Executables live in workers
+        (service/engine.py dispatch seams, service/aot.py store); the
+        parent routes bytes
 
 Exit 0 = clean.  Run: ``python scripts/lint_lite.py`` (from repo root).
 Also executed by tests/test_import_hygiene.py so the default test tier
@@ -208,7 +216,7 @@ _DIGEST_HOST_LEGS = {"_dealer_row_digests"}
 # the flight-recorder JSONL sink and the persistent table cache.
 # dkg_tpu/net/ is excluded from DKG006's write check because DKG005
 # already polices it more strictly (WAL-only).
-_DKG006_WRITER_ALLOWLIST = {"obslog.py", "precompute.py"}
+_DKG006_WRITER_ALLOWLIST = {"obslog.py", "precompute.py", "aot.py"}
 
 # Execution-context constructors banned in dkg_tpu/service/ outside the
 # sanctioned owners (DKG007): the worker pool in scheduler.py and the
@@ -221,7 +229,7 @@ _SERVICE_SPAWNERS = {
     "start_new_thread",
     "run_in_executor",
 }
-_SERVICE_SPAWN_OWNERS = {"scheduler.py", "httpobs.py"}
+_SERVICE_SPAWN_OWNERS = {"scheduler.py", "httpobs.py", "fleet.py"}
 
 # Per-pair EC scalar multiplication entry points banned inside loops in
 # dkg_tpu/epoch/ (DKG008): a host scalar_mul per (dealer, recipient)
@@ -249,6 +257,7 @@ _DKG010_RECORDERS = {
     "_poison_sign_one",
     "_retry_transient",
     "_note",
+    "note_error",
     "record_done",
     "_finish_one",
 }
@@ -320,6 +329,7 @@ class _Checker(ast.NodeVisitor):
         self._epoch_module = "dkg_tpu/epoch/" in path.as_posix()
         self._sign_module = "dkg_tpu/sign/" in path.as_posix()
         self._parallel_module = "dkg_tpu/parallel/" in path.as_posix()
+        self._fleet_module = self._service_module and path.name == "fleet.py"
         self._dem_hot_module = (
             self._dkg_module and path.name in _DEM_HOT_MODULES
         )
@@ -391,6 +401,17 @@ class _Checker(ast.NodeVisitor):
             local = (alias.asname or alias.name).split(".")[0]
             reexport = alias.asname is not None and alias.asname == alias.name
             self.imports.append((node.lineno, local, "F401", reexport))
+            # DKG016: the fleet control plane never touches jax — at any
+            # nesting depth (a function-level import is still a tracing
+            # entry point waiting to happen on the request path)
+            if self._fleet_module and alias.name.split(".")[0] == "jax":
+                self._add(
+                    node,
+                    "DKG016",
+                    "jax imported in service/fleet.py — the fleet front "
+                    "door is device-free; executables live in worker "
+                    "processes behind the AOT store (service/aot.py)",
+                )
         self.generic_visit(node)
 
     def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
@@ -400,6 +421,19 @@ class _Checker(ast.NodeVisitor):
             local = alias.asname or alias.name
             reexport = alias.asname is not None and alias.asname == alias.name
             self.imports.append((node.lineno, local, "F401", reexport))
+            # DKG016 (from-import spelling): see visit_Import
+            if (
+                self._fleet_module
+                and node.module
+                and node.module.split(".")[0] == "jax"
+            ):
+                self._add(
+                    node,
+                    "DKG016",
+                    "jax imported in service/fleet.py — the fleet front "
+                    "door is device-free; executables live in worker "
+                    "processes behind the AOT store (service/aot.py)",
+                )
             # DKG015a: importing mesh machinery from jax outside the
             # parallel layer — aliasing (``PartitionSpec as P``) is the
             # common spelling, so the import is where the rule bites.

@@ -40,7 +40,10 @@ The multi-tenant service has its own history, ``FLEET_r{NN}.json``
 same way — FAIL when ``ceremonies_per_s`` dropped more than the
 threshold, or when the tail latency ``p99_s`` ROSE more than the
 threshold (a throughput win bought by starving the queue tail is a
-regression for a service).  The same forgiveness rules apply: fewer
+regression for a service), or when ``warmup_s`` ROSE more than the
+threshold (the cold-start gate: the AOT executable store took warmup
+from minutes of recompiles to seconds of deserializes, and a quiet
+slide back must fail here).  The same forgiveness rules apply: fewer
 than two comparable fleet rounds, mismatched platforms, or mismatched
 service shapes (concurrency/batch_max) skip with a note.
 
@@ -411,6 +414,34 @@ def fleet_gate(root: pathlib.Path, threshold: float) -> int:
             bad = 1
         else:
             print(line)
+    # cold-start gate: warmup_s RISING is a regression — the AOT
+    # executable store (service/aot.py) took process warmup from
+    # minutes of recompiles to seconds of deserializes, and a quiet
+    # slide back (store misses, digest skew, a widened warm set) must
+    # fail here, not resurface as FLEET_r01's 222.6s
+    old_wu, new_wu = old.get("warmup_s"), new.get("warmup_s")
+    if (
+        isinstance(old_wu, (int, float)) and old_wu > 0
+        and isinstance(new_wu, (int, float)) and new_wu > 0
+    ):
+        change = (new_wu - old_wu) / old_wu
+        line = (
+            f"perf_regress: fleet warmup_s r{old_n} {old_wu:.1f} -> "
+            f"r{new_n} {new_wu:.1f} s ({change:+.1%})"
+        )
+        if change > threshold:
+            print(
+                f"{line} — COLD-START REGRESSION beyond {threshold:.0%}",
+                file=sys.stderr,
+            )
+            bad = 1
+        else:
+            print(line)
+    else:
+        print(
+            f"perf_regress: fleet warmup_s absent in r{old_n} or "
+            f"r{new_n} — skipping the cold-start gate"
+        )
     # wire growth gates like p99: RISES are regressions (the mix is
     # pinned by the shape keys above, so per-ceremony average traffic
     # only moves when the protocol's wire format does)

@@ -1,0 +1,296 @@
+"""Fleet front door: routing, shed, scale — with in-process fakes.
+
+The real fleet spawns scheduler processes (minutes of warmup without a
+baked AOT store); the control plane's decisions are pure Python over
+the worker protocol, so fakes exercise every branch in milliseconds.
+The spawned-process path itself is covered by scripts/fleet_bench.py's
+--procs leg.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dkg_tpu.service import buckets, errors
+from dkg_tpu.service.fleet import FleetServer
+from dkg_tpu.utils.metrics import MetricsRegistry
+
+
+class FakeWorker:
+    """Speaks the worker protocol from memory: every op answers
+    instantly, with knobs for the failure modes the fleet reacts to."""
+
+    def __init__(self, index):
+        self.index = index
+        self.warmup_s = 0.01
+        self.submitted = []
+        self.signed = []
+        self.stopped = None  # drain flag once stopped
+        self.queue_full = False
+        self.slo_ok = True
+        self.burn = 0.0
+        self.queue_depth = 0
+        self._alive = True
+        self._serial = 0
+
+    def alive(self):
+        return self._alive
+
+    def stop(self, drain=True, timeout=None):
+        self.stopped = drain
+        self._alive = False
+
+    def call(self, op, timeout=None, **kw):
+        if op == "submit":
+            if self.queue_full:
+                return {"ok": False, "error": "queue_full", "detail": "wal full"}
+            self._serial += 1
+            cid = f"w{self.index}-c{self._serial}"
+            self.submitted.append((cid, kw["req"]))
+            return {"ok": True, "cid": cid}
+        if op == "poll":
+            return {"ok": True, "status": "done"}
+        if op == "result":
+            if not any(c == kw["cid"] for c, _ in self.submitted):
+                return {"ok": False, "error": "KeyError", "detail": "unknown"}
+            return {
+                "ok": True,
+                "outcome": {
+                    "ceremony_id": kw["cid"],
+                    "status": "done",
+                    "master": "ab" * 16,
+                },
+            }
+        if op == "sign":
+            self.signed.append((kw["cid"], kw["msgs"]))
+            return {"ok": True, "sigs": ["cd" * 32 for _ in kw["msgs"]]}
+        if op == "health":
+            return {
+                "ok": True,
+                "health": {
+                    "ok": self._alive,
+                    "queue_depth": self.queue_depth,
+                    "queue_capacity": 8,
+                },
+            }
+        if op == "slo":
+            return {
+                "ok": True,
+                "slo": {
+                    "ok": self.slo_ok,
+                    "violations": [] if self.slo_ok else ["ceremony_p99"],
+                    "errors": {"burn": self.burn},
+                },
+            }
+        if op == "stats":
+            return {"ok": True, "aot": {}}
+        raise AssertionError(f"unexpected op {op!r}")
+
+
+@pytest.fixture()
+def fleet_factory():
+    """Builds fleets over FakeWorkers and closes them on teardown."""
+    made = []
+    workers = []
+
+    def make(**kw):
+        kw.setdefault("procs", 2)
+        kw.setdefault("k_min", 1)
+        kw.setdefault("k_max", 3)
+        kw.setdefault("metrics", MetricsRegistry())
+
+        def factory(idx):
+            w = FakeWorker(idx)
+            workers.append(w)
+            return w
+
+        kw.setdefault("worker_factory", factory)
+        f = FleetServer(**kw)
+        made.append(f)
+        return f, workers
+
+    yield make
+    for f in made:
+        f.close(drain=False)
+
+
+def _req(curve="ristretto255", n=8, t=2):
+    return {"curve": curve, "n": n, "t": t, "seed": 7}
+
+
+def test_routing_is_bucket_sticky(fleet_factory):
+    fleet, workers = fleet_factory()
+    # every submission of one bucket lands on the same worker; the
+    # follow-up poll/result/sign all reach the worker that holds it
+    cids = [fleet.submit(_req()) for _ in range(4)]
+    owners = {
+        next(w.index for w in workers if any(c == cid for c, _ in w.submitted))
+        for cid in cids
+    }
+    assert len(owners) == 1
+
+    assert fleet.poll(cids[0]) == "done"
+    out = fleet.result(cids[0])
+    assert out["status"] == "done" and out["ceremony_id"] == cids[0]
+    sigs = fleet.sign(cids[0], [b"msg"])
+    assert len(sigs) == 1 and isinstance(sigs[0], bytes)
+
+    # a different bucket may hash elsewhere; whichever worker it picks,
+    # the placement map routes its result back correctly
+    cid2 = fleet.submit(_req(n=64, t=16))
+    assert fleet.result(cid2)["ceremony_id"] == cid2
+    assert buckets.bucket_for(64, 16) != buckets.bucket_for(8, 2)
+
+
+def test_worker_queue_full_becomes_queue_full_error(fleet_factory):
+    fleet, workers = fleet_factory(procs=1, k_min=1, k_max=1)
+    workers[0].queue_full = True
+    with pytest.raises(errors.QueueFullError):
+        fleet.submit(_req())
+    assert fleet.metrics.snapshot()["counters"]["fleet_shed_total"] == 1
+
+
+def test_malformed_submit_is_value_error(fleet_factory):
+    fleet, _ = fleet_factory()
+    with pytest.raises(ValueError):
+        fleet.submit({"curve": "ristretto255"})  # no n/t
+    with pytest.raises(KeyError):
+        fleet.result("no-such-cid")
+
+
+def test_breach_sheds_and_scales_up(fleet_factory):
+    fleet, workers = fleet_factory(procs=2, k_min=1, k_max=3)
+    workers[0].slo_ok = False  # p99 breach on one worker
+    dec = fleet._control_once()
+    assert dec["decision"] == "up" and dec["breach"] and dec["shedding"]
+    assert len(fleet._workers) == 3
+
+    # shedding: new submissions take the 503 path
+    with pytest.raises(errors.QueueFullError):
+        fleet.submit(_req())
+
+    # at k_max a persisting breach holds (keeps shedding), never overshoots
+    dec = fleet._control_once()
+    assert dec["decision"] == "hold" and dec["shedding"]
+    assert len(fleet._workers) == 3
+
+    # recovery: objectives met again -> shedding clears, admission resumes
+    workers[0].slo_ok = True
+    dec = fleet._control_once()
+    assert not dec["shedding"]
+    fleet.submit(_req())
+
+
+def test_error_budget_burn_triggers_scale_up(fleet_factory):
+    fleet, workers = fleet_factory(procs=1, k_min=1, k_max=2)
+    workers[0].burn = 1.5  # objectives still "ok" but budget burning
+    dec = fleet._control_once()
+    assert dec["decision"] == "up" and dec["burn"] == 1.5
+    assert len(fleet._workers) == 2
+
+
+def test_sustained_idle_scales_down_to_floor(fleet_factory):
+    fleet, workers = fleet_factory(procs=3, k_min=1, k_max=3, idle_rounds_down=3)
+    for _ in range(2):
+        assert fleet._control_once()["decision"] == "hold"
+    dec = fleet._control_once()  # third consecutive idle round
+    assert dec["decision"] == "down"
+    assert len(fleet._workers) == 2
+    assert workers[2].stopped is True  # drained, not killed
+
+    # a busy queue resets the idle counter
+    workers[0].queue_depth = 5
+    for _ in range(4):
+        assert fleet._control_once()["decision"] == "hold"
+    assert len(fleet._workers) == 2
+
+    # idle again: down to the floor, never below
+    workers[0].queue_depth = 0
+    for _ in range(12):
+        fleet._control_once()
+    assert len(fleet._workers) == 1
+
+
+def test_dead_worker_reaped_and_replaced(fleet_factory):
+    fleet, workers = fleet_factory(procs=2, k_min=2, k_max=3)
+    workers[1]._alive = False  # crashed without a goodbye
+    fleet._control_once()
+    pool = fleet._workers
+    assert len(pool) == 2 and all(w.alive() for w in pool)
+    assert (
+        fleet.metrics.snapshot()["counters"]["fleet_worker_restarts_total"] == 1
+    )
+    # routing never offers the dead worker
+    cid = fleet.submit(_req())
+    assert fleet.result(cid)["ceremony_id"] == cid
+
+
+def test_health_and_describe_shapes(fleet_factory):
+    fleet, _ = fleet_factory(procs=2)
+    h = fleet.health()
+    assert h["ok"] and h["workers_alive"] == 2
+    r = fleet.slo_report()
+    assert r["ok"] and len(r["workers"]) == 2
+    d = fleet.describe()
+    assert d["workers"] == 2 and d["k_max"] == 3 and not d["shedding"]
+
+
+def test_http_front_door(fleet_factory):
+    fleet, workers = fleet_factory(procs=1, k_min=1, k_max=1, http_port=0)
+    base = f"http://127.0.0.1:{fleet.port}"
+
+    def post(path, payload):
+        req = urllib.request.Request(
+            base + path,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as exc:
+            return exc.code, json.loads(exc.read())
+
+    def get(path):
+        try:
+            with urllib.request.urlopen(base + path, timeout=10) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as exc:
+            return exc.code, json.loads(exc.read())
+
+    code, body = post("/submit", _req())
+    assert code == 200
+    cid = body["ceremony_id"]
+    assert get(f"/poll?cid={cid}") == (200, {"ceremony_id": cid, "status": "done"})
+    code, body = get(f"/result?cid={cid}&timeout=5")
+    assert code == 200 and body["ceremony_id"] == cid
+    code, body = post("/sign", {"cid": cid, "msgs": [b"hi".hex()]})
+    assert code == 200 and len(body["signatures"]) == 1
+    code, body = get("/fleet")
+    assert code == 200 and body["workers"] == 1
+    assert post("/submit", {"curve": "x"})[0] == 400  # no n/t
+
+    # scrape surface still serves beside the front door
+    with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
+        assert resp.status == 200
+        assert b"fleet_requests_total" in resp.read()
+
+    # the 503 path: worker full, then fleet-level shed
+    workers[0].queue_full = True
+    code, body = post("/submit", _req())
+    assert code == 503 and body["error"] == "unavailable"
+    workers[0].queue_full = False
+    workers[0].slo_ok = False
+    fleet._control_once()
+    code, body = post("/submit", _req())
+    assert code == 503 and "shedding" in body["detail"]
+
+    # unknown routes keep their HTTP contracts even while shedding
+    assert get("/result?cid=nope")[0] == 404
+    assert post("/sign", {"cid": "nope", "msgs": []})[0] == 404
+    assert get("/no-such-route")[0] == 404
