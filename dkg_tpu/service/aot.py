@@ -86,6 +86,10 @@ _TIER_KNOBS = (
 )
 
 _LOCK = threading.RLock()
+#: Per-key build/load locks: the global lock only guards the maps, so a
+#: minutes-long XLA compile for one program never stalls an unrelated
+#: key's lookup (e.g. the steady sign lane behind a ceremony build).
+_KEY_LOCKS: dict[tuple, threading.Lock] = {}
 _PROC: dict[tuple, object] = {}
 _STATS = {
     "builds": 0,
@@ -176,12 +180,14 @@ def _load_blob(path: str, key: tuple):
     except FileNotFoundError:
         return None
     except Exception:
-        _STATS["disk_rejects"] += 1
+        with _LOCK:  # may run outside the global lock (get_or_build)
+            _STATS["disk_rejects"] += 1
         REGISTRY.inc("aot_disk_rejects_total")
         return None
     dt = time.perf_counter() - t0
-    _STATS["disk_loads"] += 1
-    _STATS["load_s"] += dt
+    with _LOCK:
+        _STATS["disk_loads"] += 1
+        _STATS["load_s"] += dt
     REGISTRY.inc("aot_disk_loads_total")
     REGISTRY.observe("aot_load_seconds", dt)
     return fn
@@ -224,14 +230,25 @@ def get_or_build(key: tuple, build):
         if hit is not None:
             _STATS["proc_hits"] += 1
             return hit
+        klock = _KEY_LOCKS.setdefault(key, threading.Lock())
+    # the slow path (deserialize or compile) runs under the KEY's lock
+    # only: concurrent lookups of other keys proceed, concurrent
+    # lookups of this key wait and then hit the cache
+    with klock:
+        with _LOCK:
+            hit = _PROC.get(key)
+            if hit is not None:
+                _STATS["proc_hits"] += 1
+                return hit
         path = _path(key)
         fn = _load_blob(path, key)
         if fn is None:
             t0 = time.perf_counter()
             fn = build()
             dt = time.perf_counter() - t0
-            _STATS["builds"] += 1
-            _STATS["build_s"] += dt
+            with _LOCK:
+                _STATS["builds"] += 1
+                _STATS["build_s"] += dt
             REGISTRY.inc("aot_builds_total")
             REGISTRY.observe("aot_build_seconds", dt)
             try:
@@ -240,9 +257,11 @@ def get_or_build(key: tuple, build):
             except Exception:
                 # some backends can't serialize; the compiled program
                 # still serves this process
-                _STATS["errors"] += 1
+                with _LOCK:
+                    _STATS["errors"] += 1
                 REGISTRY.inc("aot_errors_total")
-        _PROC[key] = fn
+        with _LOCK:
+            _PROC[key] = fn
         return fn
 
 
@@ -375,6 +394,7 @@ def reset(clear_disk: bool = False) -> None:
     global _PRELOADED, _DISK
     with _LOCK:
         _PROC.clear()
+        _KEY_LOCKS.clear()
         _PRELOADED = False
         _DISK = None
         for k in _STATS:

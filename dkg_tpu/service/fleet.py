@@ -15,7 +15,10 @@ minutes.  This module is the control plane over those workers:
   the on-disk stores (the environment — ``DKG_TPU_AOT_DIR`` included —
   is inherited), so worker N+1 warms from worker 0's bake.  Parent and
   child speak length-framed pickles over a ``Pipe``; one request, one
-  reply, serialized per worker by a parent-side lock.
+  reply, serialized per worker by a parent-side lock, every request
+  tagged with an id the reply must echo — a late reply to an op the
+  parent already timed out on is discarded, never served to the next
+  caller as its answer.
 * **Routing** — requests land on a worker by their shape bucket
   (BLAKE2b of ``(curve, bucket.n, bucket.t)`` mod alive workers), so a
   bucket's convoys keep stacking inside one scheduler instead of
@@ -71,6 +74,17 @@ class WorkerUnavailable(RuntimeError):
     """The routed worker died or timed out mid-request."""
 
 
+class WorkerBusy(WorkerUnavailable):
+    """The worker is alive but its pipe is serving a long data-plane op
+    (e.g. a blocking ``result`` wait) — control-plane callers that asked
+    for a bounded lock wait report it busy instead of stalling."""
+
+#: How long a control-plane op (health/slo) waits for a worker's pipe
+#: lock before reporting the worker busy instead of blocking behind a
+#: long data-plane call.
+_BUSY_LOCK_TIMEOUT_S = 1.0
+
+
 def _outcome_wire(out) -> dict:
     """JSON-able public view of a CeremonyOutcome — ``final_shares``
     (secret) never crosses the pipe."""
@@ -115,6 +129,7 @@ def _proc_worker_main(conn, cfg: dict) -> None:
         except (EOFError, OSError):
             break
         op = msg.get("op")
+        rid = msg.get("rid")
         try:
             if op == "submit":
                 req = _engine.CeremonyRequest(**msg["req"])
@@ -122,7 +137,7 @@ def _proc_worker_main(conn, cfg: dict) -> None:
             elif op == "poll":
                 reply = {"ok": True, "status": sched.poll(msg["cid"])}
             elif op == "result":
-                out = sched.result(msg["cid"], timeout=msg.get("timeout"))
+                out = sched.result(msg["cid"], timeout=msg.get("wait_s"))
                 reply = {"ok": True, "outcome": _outcome_wire(out)}
             elif op == "sign":
                 sigs = sched.sign(
@@ -140,7 +155,7 @@ def _proc_worker_main(conn, cfg: dict) -> None:
                 reply = {"ok": True, "aot": _aot.stats()}
             elif op == "close":
                 sched.close(drain=bool(msg.get("drain", True)))
-                conn.send({"ok": True})
+                conn.send({"ok": True, "rid": rid})
                 break
             else:
                 reply = {"ok": False, "error": f"unknown op {op!r}"}
@@ -149,6 +164,7 @@ def _proc_worker_main(conn, cfg: dict) -> None:
         except Exception as exc:  # worker must answer, never die silent
             REGISTRY.inc("fleet_worker_errors_total")
             reply = {"ok": False, "error": type(exc).__name__, "detail": str(exc)}
+        reply["rid"] = rid
         try:
             conn.send(reply)
         except (BrokenPipeError, OSError):
@@ -162,6 +178,7 @@ class _ProcWorker:
         self.index = index
         self.warmup_s: float | None = None
         self._lock = threading.Lock()
+        self._next_rid = 0
         ctx = multiprocessing.get_context("spawn")
         self._conn, child = ctx.Pipe(duplex=True)
         self._proc = ctx.Process(
@@ -176,10 +193,29 @@ class _ProcWorker:
     def alive(self) -> bool:
         return self._proc.is_alive()
 
-    def call(self, op: str, timeout: float | None = None, **kw) -> dict:
-        with self._lock:
+    def call(
+        self,
+        op: str,
+        timeout: float | None = None,
+        lock_timeout: float | None = None,
+        **kw,
+    ) -> dict:
+        if lock_timeout is None:
+            self._lock.acquire()
+        elif not self._lock.acquire(timeout=lock_timeout):
+            raise WorkerBusy(
+                f"worker {self.index}: pipe busy, lock not free "
+                f"within {lock_timeout}s"
+            )
+        try:
+            # Request ids keep the one-request-one-reply framing honest
+            # across op timeouts: a reply to an op the parent already
+            # gave up on (WorkerUnavailable) still lands in the pipe
+            # later, and must never be handed to the NEXT caller.
+            self._next_rid += 1
+            rid = self._next_rid
             try:
-                self._conn.send({"op": op, **kw})
+                self._conn.send({"op": op, "rid": rid, **kw})
                 while True:
                     if timeout is not None and not self._conn.poll(timeout):
                         raise WorkerUnavailable(
@@ -191,11 +227,15 @@ class _ProcWorker:
                     if isinstance(reply, dict) and reply.get("op") == "ready":
                         self.warmup_s = reply["warmup_s"]
                         continue
+                    if isinstance(reply, dict) and reply.get("rid") != rid:
+                        continue  # stale reply to a timed-out op
                     return reply
             except (EOFError, OSError, BrokenPipeError) as exc:
                 raise WorkerUnavailable(
                     f"worker {self.index} died mid-{op}: {exc}"
                 ) from exc
+        finally:
+            self._lock.release()
 
     def wait_ready(self, timeout: float) -> float | None:
         """Block until the worker's ready banner (its warmup seconds),
@@ -287,7 +327,11 @@ class FleetServer:
         )
         self._lock = threading.RLock()
         self._workers: list = []
-        self._placed: dict[str, object] = {}
+        #: cid -> [worker, result_fetched].  Entries live as long as
+        #: their worker does (sign keeps routing to it after the result
+        #: is fetched) and are evicted when the worker is reaped,
+        #: drained or closed — the map never outlives the pool.
+        self._placed: dict[str, list] = {}
         self._next_index = 0
         self._shedding = False
         self._idle_rounds = 0
@@ -382,12 +426,20 @@ class FleetServer:
             raise ValueError(reply.get("detail") or reply.get("error", "submit failed"))
         cid = reply["cid"]
         with self._lock:
-            self._placed[cid] = w
+            self._placed[cid] = [w, False]
         return cid
 
     def _placed_worker(self, cid: str):
         with self._lock:
-            return self._placed.get(cid)
+            entry = self._placed.get(cid)
+            return entry[0] if entry is not None else None
+
+    def _evict_placed(self, workers) -> None:
+        """Drop placement entries for workers leaving the pool.  Caller
+        holds ``self._lock``."""
+        gone = set(map(id, workers))
+        for cid in [c for c, e in self._placed.items() if id(e[0]) in gone]:
+            del self._placed[cid]
 
     def poll(self, cid: str) -> str:
         w = self._placed_worker(cid)
@@ -400,10 +452,20 @@ class FleetServer:
         w = self._placed_worker(cid)
         if w is None:
             raise KeyError(f"unknown ceremony {cid!r}")
+        # the scheduler wait rides IN the message; the pipe budget is
+        # strictly larger, so a slow ceremony surfaces as the worker's
+        # clean TimeoutError reply, never a parent-side pipe timeout
         budget = timeout if timeout is not None else self.op_timeout_s
-        reply = w.call("result", cid=cid, timeout=budget + 10.0)
+        reply = w.call("result", cid=cid, wait_s=budget, timeout=budget + 10.0)
         if not reply.get("ok"):
-            raise errors.ServiceError(reply.get("detail") or reply.get("error"))
+            detail = reply.get("detail") or reply.get("error")
+            if reply.get("error") == "TimeoutError":
+                raise TimeoutError(detail)
+            raise errors.ServiceError(detail)
+        with self._lock:
+            entry = self._placed.get(cid)
+            if entry is not None:
+                entry[1] = True
         return reply["outcome"]
 
     def sign(self, cid: str, msgs: list[bytes], **kw) -> list[bytes]:
@@ -430,10 +492,19 @@ class FleetServer:
                 per.append({"worker": w.index, "ok": False, "alive": False})
                 continue
             try:
-                h = w.call("health", timeout=_CONTROL_TIMEOUT_S)
+                h = w.call(
+                    "health",
+                    timeout=_CONTROL_TIMEOUT_S,
+                    lock_timeout=_BUSY_LOCK_TIMEOUT_S,
+                )
                 per.append(
                     {"worker": w.index, "alive": True, **h.get("health", {})}
                 )
+            except WorkerBusy:
+                # pipe held by a long data-plane op: alive, just busy —
+                # /healthz must answer now, not after that op drains
+                per.append({"worker": w.index, "ok": True, "alive": True,
+                            "busy": True})
             except WorkerUnavailable:
                 per.append({"worker": w.index, "ok": False, "alive": False})
         alive = [p for p in per if p.get("alive")]
@@ -451,10 +522,14 @@ class FleetServer:
         per = []
         for w in ws:
             try:
-                r = w.call("slo", timeout=_CONTROL_TIMEOUT_S)
+                r = w.call(
+                    "slo",
+                    timeout=_CONTROL_TIMEOUT_S,
+                    lock_timeout=_BUSY_LOCK_TIMEOUT_S,
+                )
                 if r.get("ok"):
                     per.append({"worker": w.index, **r["slo"]})
-            except WorkerUnavailable:
+            except WorkerUnavailable:  # includes WorkerBusy
                 continue
         violations = [
             v for r in per for v in r.get("violations", ())
@@ -483,11 +558,13 @@ class FleetServer:
         with self._lock:
             ws = list(self._workers)
             # reap workers that died (crash, OOM-kill): routing already
-            # skips them, this trims the pool and frees the pipe
+            # skips them, this trims the pool, frees the pipe, and
+            # forgets placements nobody can serve anymore
             dead = [w for w in ws if not w.alive()]
             for w in dead:
                 self._workers.remove(w)
                 self.metrics.inc("fleet_worker_restarts_total")
+            self._evict_placed(dead)
             # keep the pool at the floor: a crashed worker is replaced
             # even in a healthy window
             while len(self._workers) < self.k_min and not self._closing:
@@ -496,9 +573,17 @@ class FleetServer:
         reports, healths = [], []
         for w in ws:
             try:
-                r = w.call("slo", timeout=_CONTROL_TIMEOUT_S)
-                h = w.call("health", timeout=_CONTROL_TIMEOUT_S)
-            except WorkerUnavailable:
+                r = w.call(
+                    "slo",
+                    timeout=_CONTROL_TIMEOUT_S,
+                    lock_timeout=_BUSY_LOCK_TIMEOUT_S,
+                )
+                h = w.call(
+                    "health",
+                    timeout=_CONTROL_TIMEOUT_S,
+                    lock_timeout=_BUSY_LOCK_TIMEOUT_S,
+                )
+            except WorkerUnavailable:  # includes WorkerBusy
                 continue
             if r.get("ok"):
                 reports.append(r["slo"])
@@ -526,17 +611,28 @@ class FleetServer:
                     self._idle_rounds += 1
                 else:
                     self._idle_rounds = 0
+                victim = None
                 if (
                     self._idle_rounds >= self.idle_rounds_down
                     and alive > self.k_min
                     and not self._closing
                 ):
-                    victim = self._workers.pop()
+                    # drain only a worker whose completed-but-unfetched
+                    # results nobody is still owed: stopping the process
+                    # would lose them (poll -> unknown, result -> 409)
+                    unfetched = {
+                        id(e[0]) for e in self._placed.values() if not e[1]
+                    }
+                    for cand in reversed(self._workers):
+                        if id(cand) not in unfetched:
+                            victim = cand
+                            break
+                if victim is not None:
+                    self._workers.remove(victim)
+                    self._evict_placed([victim])
                     decision = "down"
                     self._idle_rounds = 0
                     self.metrics.inc("fleet_scale_total", direction="down")
-                else:
-                    victim = None
             self.metrics.set_gauge("fleet_workers", len(self._workers))
             self.metrics.set_gauge("fleet_shedding", 1.0 if self._shedding else 0.0)
         if decision == "down":
@@ -584,6 +680,9 @@ class FleetServer:
                 return 200, self.result(cid, timeout=timeout)
             except KeyError:
                 return 404, {"error": "unknown ceremony", "ceremony_id": cid}
+            except TimeoutError as exc:
+                return 504, {"error": "timeout", "detail": str(exc),
+                             "ceremony_id": cid}
             except (RuntimeError, ValueError) as exc:
                 return 409, {"error": str(exc), "ceremony_id": cid}
         if method == "POST" and path == "/sign":
@@ -625,5 +724,6 @@ class FleetServer:
         with self._lock:
             ws = list(self._workers)
             self._workers.clear()
+            self._placed.clear()
         for w in ws:
             w.stop(drain=drain)

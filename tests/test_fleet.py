@@ -10,13 +10,19 @@ The spawned-process path itself is covered by scripts/fleet_bench.py's
 from __future__ import annotations
 
 import json
+import threading
 import urllib.error
 import urllib.request
 
 import pytest
 
 from dkg_tpu.service import buckets, errors
-from dkg_tpu.service.fleet import FleetServer
+from dkg_tpu.service.fleet import (
+    FleetServer,
+    WorkerBusy,
+    WorkerUnavailable,
+    _ProcWorker,
+)
 from dkg_tpu.utils.metrics import MetricsRegistry
 
 
@@ -29,8 +35,11 @@ class FakeWorker:
         self.warmup_s = 0.01
         self.submitted = []
         self.signed = []
+        self.result_calls = []
         self.stopped = None  # drain flag once stopped
         self.queue_full = False
+        self.result_timeout = False
+        self.busy = False
         self.slo_ok = True
         self.burn = 0.0
         self.queue_depth = 0
@@ -44,7 +53,9 @@ class FakeWorker:
         self.stopped = drain
         self._alive = False
 
-    def call(self, op, timeout=None, **kw):
+    def call(self, op, timeout=None, lock_timeout=None, **kw):
+        if self.busy and lock_timeout is not None:
+            raise WorkerBusy(f"worker {self.index} busy")
         if op == "submit":
             if self.queue_full:
                 return {"ok": False, "error": "queue_full", "detail": "wal full"}
@@ -55,6 +66,13 @@ class FakeWorker:
         if op == "poll":
             return {"ok": True, "status": "done"}
         if op == "result":
+            self.result_calls.append(dict(kw))
+            if self.result_timeout:
+                return {
+                    "ok": False,
+                    "error": "TimeoutError",
+                    "detail": f"ceremony {kw['cid']} still running",
+                }
             if not any(c == kw["cid"] for c, _ in self.submitted):
                 return {"ok": False, "error": "KeyError", "detail": "unknown"}
             return {
@@ -294,3 +312,155 @@ def test_http_front_door(fleet_factory):
     assert get("/result?cid=nope")[0] == 404
     assert post("/sign", {"cid": "nope", "msgs": []})[0] == 404
     assert get("/no-such-route")[0] == 404
+
+
+class _ScriptedConn:
+    """A Pipe end driven from a script: replies pop in order, polls see
+    whatever is queued right now."""
+
+    def __init__(self):
+        self.sent = []
+        self.replies = []
+
+    def send(self, msg):
+        self.sent.append(msg)
+
+    def poll(self, timeout=None):
+        return bool(self.replies)
+
+    def recv(self):
+        if not self.replies:
+            raise EOFError("script exhausted")
+        return self.replies.pop(0)
+
+
+def _bare_proc_worker(conn):
+    """A _ProcWorker over a scripted conn — no process is spawned, so
+    the framing logic is testable in-process."""
+    w = _ProcWorker.__new__(_ProcWorker)
+    w.index = 0
+    w.warmup_s = 0.0
+    w._lock = threading.Lock()
+    w._next_rid = 0
+    w._conn = conn
+    return w
+
+
+def test_stale_reply_after_timeout_is_discarded():
+    """An op timeout must not desync the pipe: the late reply to the
+    abandoned op is dropped by its request id, and the next call gets
+    ITS OWN reply — never another ceremony's outcome."""
+    conn = _ScriptedConn()
+    w = _bare_proc_worker(conn)
+
+    # op 1 times out (no reply queued yet)
+    with pytest.raises(WorkerUnavailable):
+        w.call("result", cid="slow", timeout=0.01)
+    rid1 = conn.sent[0]["rid"]
+
+    # the worker finishes op 1 late; then answers op 2
+    conn.replies.append(
+        {"ok": True, "outcome": {"ceremony_id": "slow"}, "rid": rid1}
+    )
+    conn.replies.append({"ok": True, "status": "queued", "rid": rid1 + 1})
+    reply = w.call("poll", cid="other", timeout=1.0)
+    assert reply == {"ok": True, "status": "queued", "rid": rid1 + 1}
+    assert conn.sent[1]["rid"] == rid1 + 1
+    assert not conn.replies  # the stale outcome was consumed and dropped
+
+
+def test_call_requests_carry_monotonic_ids():
+    conn = _ScriptedConn()
+    w = _bare_proc_worker(conn)
+    for i in (1, 2, 3):
+        conn.replies.append({"ok": True, "rid": i})
+        assert w.call("health", timeout=1.0)["rid"] == i
+    assert [m["rid"] for m in conn.sent] == [1, 2, 3]
+
+
+def test_busy_pipe_raises_worker_busy_not_blocks():
+    conn = _ScriptedConn()
+    w = _bare_proc_worker(conn)
+    w._lock.acquire()  # a long data-plane op holds the pipe
+    try:
+        with pytest.raises(WorkerBusy):
+            w.call("health", timeout=1.0, lock_timeout=0.05)
+        # a data-plane call without lock_timeout would block: not tested
+        # here (it would deadlock), but the control plane stays live
+    finally:
+        w._lock.release()
+
+
+def test_result_timeout_forwarded_and_clean(fleet_factory):
+    """The client's timeout rides to the worker's scheduler wait, and a
+    slow ceremony surfaces as TimeoutError — placement intact, so a
+    later fetch still routes."""
+    fleet, workers = fleet_factory(procs=1, k_min=1, k_max=1, http_port=0)
+    cid = fleet.submit(_req())
+    w = next(wk for wk in workers if wk.submitted)
+    w.result_timeout = True
+    with pytest.raises(TimeoutError):
+        fleet.result(cid, timeout=0.5)
+    assert w.result_calls[-1]["wait_s"] == 0.5
+    # the default budget is forwarded too (worker replies within pipe budget)
+    with pytest.raises(TimeoutError):
+        fleet.result(cid)
+    assert w.result_calls[-1]["wait_s"] == fleet.op_timeout_s
+
+    # HTTP: a clean 504, not a 409 dressed as a dead worker
+    url = f"http://127.0.0.1:{fleet.port}/result?cid={cid}&timeout=0.5"
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            code, body = resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        code, body = exc.code, json.loads(exc.read())
+    assert code == 504 and body["error"] == "timeout"
+
+    w.result_timeout = False
+    assert fleet.result(cid)["ceremony_id"] == cid
+    # ...and signing still routes after the result was fetched
+    assert len(fleet.sign(cid, [b"m"])) == 1
+
+
+def test_scale_down_spares_unfetched_results(fleet_factory):
+    """Idle scale-down never drains a worker that still holds outcomes
+    nobody fetched; once fetched, the worker becomes eligible."""
+    fleet, workers = fleet_factory(procs=2, k_min=1, k_max=2, idle_rounds_down=1)
+    w0, w1 = fleet._workers
+    fleet._placed["c0"] = [w0, False]
+    fleet._placed["c1"] = [w1, False]
+    for _ in range(3):  # idle, but every worker is owed a result
+        assert fleet._control_once()["decision"] == "hold"
+    assert len(fleet._workers) == 2
+
+    fleet._placed["c1"][1] = True  # c1 fetched: w1 is now drainable
+    dec = fleet._control_once()
+    assert dec["decision"] == "down"
+    assert fleet._workers == [w0]
+    assert w1.stopped is True
+    assert "c1" not in fleet._placed and "c0" in fleet._placed
+
+
+def test_reaped_worker_placements_are_evicted(fleet_factory):
+    fleet, _ = fleet_factory(procs=2, k_min=2, k_max=3)
+    cid = fleet.submit(_req())
+    owner = fleet._placed[cid][0]
+    owner._alive = False  # crashed with an unfetched outcome
+    fleet._control_once()
+    assert cid not in fleet._placed
+    assert fleet.describe()["placed"] == 0
+    assert fleet.poll(cid) == "unknown"
+
+
+def test_busy_worker_is_alive_in_health_and_skipped_by_control(fleet_factory):
+    fleet, workers = fleet_factory(procs=2)
+    workers[0].busy = True
+    h = fleet.health()
+    busy = [p for p in h["workers"] if p.get("busy")]
+    assert len(busy) == 1 and busy[0]["alive"] and h["ok"]
+    assert h["workers_alive"] == 2
+    # the control loop skips the busy pipe instead of stalling behind it
+    dec = fleet._control_once()
+    assert dec["workers"] == 2 and dec["decision"] == "hold"
+    r = fleet.slo_report()
+    assert len(r["workers"]) == 1  # only the free worker reported
