@@ -32,6 +32,14 @@ Recovery (:meth:`ServiceJournal.replay`) partitions replayed ids into
 *replays* count map.  The scheduler compacts the journal on recovery
 via ``PartyWal.rewrite`` so a torn tail never shadows post-restart
 appends.
+
+The fleet (service/fleet.py) reuses this machinery unchanged for
+worker failover: each worker SLOT gets its own journal directory
+(``DKG_TPU_FLEET_WAL_DIR/slotNNN``), and the replacement worker
+spawned for a dead slot simply constructs its scheduler over the same
+directory — this module's recovery re-runs the dead worker's pending
+seeded ceremonies under their original ids and re-serves its terminal
+outcomes, no fleet-specific journal code at all.
 """
 
 from __future__ import annotations

@@ -25,6 +25,11 @@ Taxonomy:
 * :class:`QueueFullError` — admission backpressure (HTTP 503); lives
   here with the rest of the taxonomy, re-exported by
   ``service.scheduler`` where it historically lived.
+* :class:`FleetSlotQuarantined` — a fleet worker slot crash-looped past
+  ``DKG_TPU_FLEET_RESPAWN_MAX`` deaths inside its window and was
+  quarantined; every placement it held is terminal-failed with this
+  type's name in the outcome error (the fleet-level mirror of
+  ``PoisonedRequest``'s replay limit).
 """
 
 from __future__ import annotations
@@ -55,6 +60,15 @@ class PoisonedRequest(ServiceError):
     exonerated.  Its outcome is terminal status ``poisoned``; retrying
     it anywhere (including journal replay, see
     ``DKG_TPU_SERVICE_MAX_REPLAYS``) is wasted work."""
+
+
+class FleetSlotQuarantined(ServiceError):
+    """A fleet worker slot died too many times within its crash-loop
+    window (``DKG_TPU_FLEET_RESPAWN_MAX`` / ``.._WINDOW_S``) and was
+    quarantined: no further respawns, and every ceremony placed on it
+    gets a typed terminal outcome naming this class.  Retrying the same
+    submission elsewhere is the caller's call — the fleet will not
+    silently re-run work a crash-looping slot may have half-done."""
 
 
 class InsufficientSigners(ServiceError, ValueError):

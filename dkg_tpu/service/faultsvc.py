@@ -35,6 +35,15 @@ via its ``fault_plan=`` constructor hook: the scheduler routes every
 engine start/finish through :meth:`on_start` / :meth:`on_finish`, so
 injection composes with monkeypatched fake engines (tests) and the real
 one (the storm) alike.
+
+One layer up, :class:`FleetFaultPlan` storms the FLEET
+(service/fleet.py): seeded schedules of worker kills (SIGKILL
+mid-ceremony; kill-during-recovery via the fleet's ``fault_plan=``
+respawn hook), pipe garbage, and per-slot journal tail corruption —
+the process-level faults scripts/fleet_storm.py drives.  A
+ServiceFaultPlan cannot cross the spawn pickle (it holds a lock), so
+in-worker faults (slow/transient) ship to fleet children as the plain
+``worker_fault=`` dict the child rebuilds a plan from.
 """
 
 from __future__ import annotations
@@ -177,6 +186,131 @@ class ServiceFaultPlan:
                 "crash_at_starts": sorted(self._crash_at),
                 "slow_s": self._slow_s,
                 "start_calls": self._start_calls,
+                "injected": dict(self.injected),
+            }
+
+
+class FleetFaultPlan:
+    """Seeded, declarative fault schedule for one fleet — the
+    process-level mirror of :class:`ServiceFaultPlan`.
+
+    Builder methods return ``self`` for chaining::
+
+        plan = (FleetFaultPlan(seed=11)
+                .kill_worker(at_submit=30)          # SIGKILL mid-ceremony
+                .kill_on_respawn(times=1)           # ...and mid-recovery
+                .garble_pipe(at_submit=50)
+                .corrupt_slot_journal(at_submit=70))
+
+    Two hooks fire it: the storm harness calls :meth:`on_submit` after
+    every accepted submission (kills/garbage/corruption keyed on the
+    submission count), and the fleet's ``_reap_and_respawn`` calls
+    :meth:`on_respawn` for every replacement worker it spawns (the
+    kill-during-recovery leg).  Every injection lands in ``injected``,
+    the ``service_faults_injected_total`` metric and the flight
+    recorder — the ground truth the storm's floors compare against.
+    """
+
+    def __init__(self, seed: int = 0) -> None:
+        self.seed = seed
+        self._lock = threading.Lock()
+        self._kill_at: set[int] = set()
+        self._garble_at: set[int] = set()
+        self._corrupt_at: set[int] = set()
+        self._recovery_kills = 0
+        self.killed_cids: list[str] = []
+        self.injected: dict[str, int] = {}
+
+    # -- builders -----------------------------------------------------------
+
+    def kill_worker(self, at_submit: int) -> "FleetFaultPlan":
+        """SIGKILL the worker holding the ``at_submit``-th accepted
+        submission (1-based) — mid-ceremony, queue and all."""
+        self._kill_at.add(at_submit)
+        return self
+
+    def kill_on_respawn(self, times: int = 1) -> "FleetFaultPlan":
+        """SIGKILL the next ``times`` replacement workers the fleet
+        spawns — the crash lands while the replacement is recovering
+        the slot journal, the hardest failover window."""
+        self._recovery_kills += times
+        return self
+
+    def garble_pipe(self, at_submit: int) -> "FleetFaultPlan":
+        """Inject one unpicklable frame into the routed worker's pipe
+        after the ``at_submit``-th accepted submission."""
+        self._garble_at.add(at_submit)
+        return self
+
+    def corrupt_slot_journal(self, at_submit: int) -> "FleetFaultPlan":
+        """Append seeded garbage to the routed worker's slot journal
+        after the ``at_submit``-th accepted submission — the torn tail
+        the NEXT recovery on that slot must compact past."""
+        self._corrupt_at.add(at_submit)
+        return self
+
+    # -- hooks ---------------------------------------------------------------
+
+    def _note(self, kind: str) -> None:
+        with self._lock:
+            self.injected[kind] = self.injected.get(kind, 0) + 1
+        REGISTRY.inc("service_faults_injected_total", kind=kind)
+        log = obslog.current()
+        if log is not None:
+            log.emit("service_fault_injected", fault=kind)
+
+    def on_submit(self, fleet, nsubmit: int, cid: str) -> None:
+        """Harness hook: fire whatever this submission count schedules
+        against the worker the fleet placed ``cid`` on."""
+        with self._lock:
+            garble = nsubmit in self._garble_at
+            corrupt = nsubmit in self._corrupt_at
+            kill = nsubmit in self._kill_at
+        if not (garble or corrupt or kill):
+            return
+        w = fleet._placed_worker(cid)
+        if w is None:
+            return
+        if garble and hasattr(w, "inject_garbage") and w.inject_garbage():
+            self._note("fleet_pipe_garbage")
+        if corrupt:
+            wal = fleet._slot_wal_dir(getattr(w, "slot", 0) or 0)
+            if wal is not None:
+                corrupt_journal(wal, seed=self.seed ^ nsubmit)
+                self._note("fleet_journal_tail")
+        if kill and hasattr(w, "kill"):
+            with fleet._lock:
+                doomed = [
+                    c for c, e in fleet._placed.items() if e[0] is w
+                ]
+            with self._lock:
+                self.killed_cids.extend(doomed)
+            self._note("fleet_kill")
+            w.kill()
+
+    def on_respawn(self, fleet, slot: int, worker) -> None:
+        """Fleet hook: called for every replacement spawn; consumes the
+        kill-during-recovery budget."""
+        with self._lock:
+            if self._recovery_kills <= 0:
+                return
+            self._recovery_kills -= 1
+        self._note("fleet_kill_recovery")
+        if hasattr(worker, "kill"):
+            worker.kill()
+
+    # -- reporting ----------------------------------------------------------
+
+    def as_dict(self) -> dict:
+        """JSON-able schedule + injection counts (storm artifacts)."""
+        with self._lock:
+            return {
+                "seed": self.seed,
+                "kill_at_submits": sorted(self._kill_at),
+                "garble_at_submits": sorted(self._garble_at),
+                "corrupt_at_submits": sorted(self._corrupt_at),
+                "recovery_kills_left": self._recovery_kills,
+                "killed_cids": list(self.killed_cids),
                 "injected": dict(self.injected),
             }
 

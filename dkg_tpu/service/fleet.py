@@ -39,25 +39,53 @@ minutes.  This module is the control plane over those workers:
   ``fleet_workers``, ``fleet_scale_total{direction}``,
   ``fleet_shed_total``, ``fleet_requests_total{route}``.
 
+* **Failover** — worker death is a non-event for clients when a
+  journal root (``DKG_TPU_FLEET_WAL_DIR`` / ``wal_root=``) is set.
+  Workers are pinned to **slots**; each slot owns a private journal
+  directory (``<root>/slotNNN``) its scheduler journals durable work
+  into.  When a slot's worker dies, its placements become *orphans*
+  (``poll`` → ``recovering``) instead of being evicted; the
+  replacement worker boots from the same slot journal — the
+  scheduler's existing recovery re-runs seeded pending ceremonies
+  under their ORIGINAL ids and re-serves terminal outcomes — and the
+  parent asks it for a ``manifest`` (every cid it knows) to repopulate
+  ``_placed``, so ``poll``/``result``/``sign`` survive the crash with
+  the original cid.  Respawn is per-slot with capped exponential
+  backoff; a slot that dies ``DKG_TPU_FLEET_RESPAWN_MAX`` times inside
+  ``DKG_TPU_FLEET_RESPAWN_WINDOW_S`` is quarantined (the crash-loop
+  guard — the fleet mirror of ``DKG_TPU_SERVICE_MAX_REPLAYS``) and its
+  placements get a typed terminal outcome naming
+  :class:`~dkg_tpu.service.errors.FleetSlotQuarantined`.  Without a
+  journal root the pre-failover behavior stands: reaped workers'
+  placements are evicted (``poll`` → ``unknown``).
+
 This module is deliberately **device-free**: it never imports jax, and
 lint rule DKG016 bans ``jax.jit`` tracing entry points here — every
 executable a request touches lives in a worker, loaded from the AOT
 store or compiled under the worker's ``WarmRuntime``.  DKG007
 sanctions this module (with scheduler/httpobs) as a service spawn
 site; the worker factory is injectable so tests drive routing, shed
-and scale decisions with in-process fakes in milliseconds.
+and scale decisions with in-process fakes in milliseconds.  Lint
+DKG017 guards the placement map: only the eviction/manifest helpers
+(``_evict_placed`` / ``_adopt_manifest`` / ``_tombstone_slot`` /
+``close``) may remove ``_placed`` entries — no silent placement drops.
 
 Knobs (all via utils.envknobs): ``DKG_TPU_FLEET_PROCS`` (initial K),
 ``DKG_TPU_FLEET_MIN`` / ``DKG_TPU_FLEET_MAX`` (scale range),
 ``DKG_TPU_FLEET_CONTROL_S`` (control-loop period),
 ``DKG_TPU_FLEET_HTTP_PORT`` (front-door port; 0 = ephemeral, unset =
-python API only).
+python API only), ``DKG_TPU_FLEET_WAL_DIR`` (per-slot journal root;
+unset = no worker recovery), ``DKG_TPU_FLEET_RESPAWN_BACKOFF_S`` /
+``DKG_TPU_FLEET_RESPAWN_MAX`` / ``DKG_TPU_FLEET_RESPAWN_WINDOW_S``
+(crash-loop containment), ``DKG_TPU_FLEET_SUBMIT_RETRY_S`` (submit
+failover backoff).
 """
 
 from __future__ import annotations
 
 import hashlib
 import multiprocessing
+import os
 import threading
 import time
 
@@ -83,6 +111,14 @@ class WorkerBusy(WorkerUnavailable):
 #: lock before reporting the worker busy instead of blocking behind a
 #: long data-plane call.
 _BUSY_LOCK_TIMEOUT_S = 1.0
+
+#: Ceiling on the per-slot respawn backoff, whatever the doubling says.
+_RESPAWN_BACKOFF_CAP_S = 30.0
+
+#: Pipe budget for one ``manifest`` ask against a replacement worker.
+#: Deliberately short: a still-warming replacement reports unavailable
+#: and the control loop (or the next poll/result) simply retries.
+_MANIFEST_TIMEOUT_S = 2.0
 
 
 def _outcome_wire(out) -> dict:
@@ -110,9 +146,29 @@ def _proc_worker_main(conn, cfg: dict) -> None:
     a request/reply loop over ``conn``.  Runs in a spawned process —
     imports happen here, after the fork-free start."""
     t0 = time.monotonic()
+    # chaos rides in as a plain dict (ServiceFaultPlan holds a lock and
+    # cannot cross the spawn pickle); the child builds its own plan.
+    # boot_fail dies before the backend imports: a crash-looping binary
+    # burns its respawn budget fast, it doesn't warm up first.
+    fault_cfg = cfg.get("fault") or {}
+    if fault_cfg.get("boot_fail"):
+        raise SystemExit(3)  # injected boot crash (storm quarantine leg)
     from . import aot as _aot
     from . import engine as _engine
     from .scheduler import CeremonyScheduler
+
+    plan = None
+    if fault_cfg:
+        from .faultsvc import ServiceFaultPlan
+
+        plan = ServiceFaultPlan(seed=int(fault_cfg.get("seed", 0)))
+        if fault_cfg.get("slow_times"):
+            plan.slow(
+                float(fault_cfg.get("slow_s", 0.0)),
+                times=int(fault_cfg["slow_times"]),
+            )
+        if fault_cfg.get("transient_times"):
+            plan.transient(times=int(fault_cfg["transient_times"]))
 
     runtime = _engine.WarmRuntime()
     for w in cfg.get("warm", ()):
@@ -121,13 +177,25 @@ def _proc_worker_main(conn, cfg: dict) -> None:
             rho_bits=w.get("rho_bits", 128), seed=0,
         )
         runtime.warmup(req, widths=tuple(w.get("widths", (1,))))
-    sched = CeremonyScheduler(runtime=runtime, **cfg.get("scheduler", {}))
+    sched = CeremonyScheduler(
+        runtime=runtime, fault_plan=plan, **cfg.get("scheduler", {})
+    )
     conn.send({"op": "ready", "warmup_s": time.monotonic() - t0})
     while True:
         try:
             msg = conn.recv()
         except (EOFError, OSError):
             break
+        except Exception:
+            # a garbled/truncated frame (corrupted IPC writer, chaos
+            # injection) must not kill the worker: note it, keep
+            # serving — the sender's op times out and the rid framing
+            # keeps later replies honest
+            REGISTRY.inc("fleet_pipe_garbage_total")
+            continue
+        if not isinstance(msg, dict):
+            REGISTRY.inc("fleet_pipe_garbage_total")
+            continue
         op = msg.get("op")
         rid = msg.get("rid")
         try:
@@ -147,6 +215,10 @@ def _proc_worker_main(conn, cfg: dict) -> None:
                     seed=msg.get("seed"),
                 )
                 reply = {"ok": True, "sigs": [s.hex() for s in sigs]}
+            elif op == "manifest":
+                # post-recovery inventory: every cid this scheduler
+                # knows (recovered or fresh), for parent placement repair
+                reply = {"ok": True, "ceremonies": sched.manifest()}
             elif op == "health":
                 reply = {"ok": True, "health": sched.health()}
             elif op == "slo":
@@ -176,6 +248,7 @@ class _ProcWorker:
 
     def __init__(self, index: int, cfg: dict) -> None:
         self.index = index
+        self.slot: int | None = None  # stamped by FleetServer._spawn
         self.warmup_s: float | None = None
         self._lock = threading.Lock()
         self._next_rid = 0
@@ -192,6 +265,27 @@ class _ProcWorker:
 
     def alive(self) -> bool:
         return self._proc.is_alive()
+
+    def kill(self) -> None:
+        """Hard-kill the child (SIGKILL) — chaos injection for the
+        fleet storm; the control plane never calls this."""
+        self._proc.kill()
+
+    def inject_garbage(self, payload: bytes = b"\x80\x04garbage") -> bool:
+        """Write one garbled frame into the worker's pipe — models a
+        corrupted IPC writer (fleet storm's pipe-garbage fault).  The
+        frame is length-complete but unpicklable, so the child's recv
+        guard counts it and keeps serving.  Returns False when the pipe
+        is busy or already broken (nothing injected)."""
+        if not self._lock.acquire(timeout=1.0):
+            return False
+        try:
+            self._conn.send_bytes(payload)
+            return True
+        except (BrokenPipeError, OSError):
+            return False
+        finally:
+            self._lock.release()
 
     def call(
         self,
@@ -269,6 +363,27 @@ class _ProcWorker:
         self._conn.close()
 
 
+class _SlotState:
+    """One worker slot's failover bookkeeping: which worker currently
+    fills it, its crash history inside the rolling window, when the
+    next respawn is allowed, and whether the crash-loop guard tripped.
+    Slots — not workers — own journal directories: worker N+1 of slot 3
+    recovers from the same ``slot003`` journal worker N wrote."""
+
+    __slots__ = (
+        "slot", "worker", "deaths", "respawn_at", "quarantined",
+        "needs_manifest",
+    )
+
+    def __init__(self, slot: int) -> None:
+        self.slot = slot
+        self.worker = None
+        self.deaths: list[float] = []  # reap timestamps inside window
+        self.respawn_at = 0.0
+        self.quarantined = False
+        self.needs_manifest = False
+
+
 class FleetServer:
     """The fleet: worker pool + router + control loop + front door.
 
@@ -293,6 +408,13 @@ class FleetServer:
         worker_factory=None,
         metrics=REGISTRY,
         op_timeout_s: float = 600.0,
+        wal_root: str | None = None,
+        respawn_backoff_s: float | None = None,
+        respawn_max: int | None = None,
+        respawn_window_s: float | None = None,
+        submit_retry_backoff_s: float | None = None,
+        fault_plan=None,
+        worker_fault: dict | None = None,
     ) -> None:
         self.metrics = metrics
         self.k_init = procs if procs is not None else (
@@ -318,20 +440,69 @@ class FleetServer:
         self.control_interval_s = control_interval_s
         self.idle_rounds_down = idle_rounds_down
         self.op_timeout_s = op_timeout_s
+        if wal_root is None:
+            wal_root = envknobs.string(
+                "DKG_TPU_FLEET_WAL_DIR",
+                "per-slot fleet journal root (unset = no worker recovery)",
+            )
+        self.wal_root = wal_root
+        if respawn_backoff_s is None:
+            respawn_backoff_s = envknobs.nonneg_float(
+                "DKG_TPU_FLEET_RESPAWN_BACKOFF_S",
+                "second-respawn backoff, doubling per death (first is free)",
+            )
+        self.respawn_backoff_s = (
+            0.5 if respawn_backoff_s is None else respawn_backoff_s
+        )
+        if respawn_max is None:
+            respawn_max = envknobs.pos_int(
+                "DKG_TPU_FLEET_RESPAWN_MAX",
+                "slot deaths inside the window before quarantine",
+            ) or 3
+        self.respawn_max = respawn_max
+        if respawn_window_s is None:
+            respawn_window_s = envknobs.pos_float(
+                "DKG_TPU_FLEET_RESPAWN_WINDOW_S",
+                "crash-loop window the death count rolls over",
+            ) or 60.0
+        self.respawn_window_s = respawn_window_s
+        if submit_retry_backoff_s is None:
+            submit_retry_backoff_s = envknobs.nonneg_float(
+                "DKG_TPU_FLEET_SUBMIT_RETRY_S",
+                "pause before the one submit retry after WorkerUnavailable",
+            )
+        self.submit_retry_backoff_s = (
+            0.05 if submit_retry_backoff_s is None else submit_retry_backoff_s
+        )
+        self._fault_plan = fault_plan
         self._cfg = {
             "scheduler": dict(scheduler_kwargs or {}),
             "warm": list(warm or ()),
         }
+        if worker_fault:
+            self._cfg["fault"] = dict(worker_fault)
         self._factory = worker_factory or (
-            lambda idx: _ProcWorker(idx, self._cfg)
+            lambda idx: _ProcWorker(idx, self._slot_cfg(self._spawning_slot))
         )
         self._lock = threading.RLock()
         self._workers: list = []
         #: cid -> [worker, result_fetched].  Entries live as long as
         #: their worker does (sign keeps routing to it after the result
-        #: is fetched) and are evicted when the worker is reaped,
-        #: drained or closed — the map never outlives the pool.
+        #: is fetched) and leave the map ONLY through the sanctioned
+        #: helpers (lint DKG017): reap-eviction, manifest adoption,
+        #: slot tombstoning, close.  With a journal root a reaped
+        #: worker's entries become orphans (worker=None) awaiting the
+        #: replacement's manifest instead of being dropped.
         self._placed: dict[str, list] = {}
+        #: cid -> slot, for placements whose worker died and whose slot
+        #: journal should resurrect them ("recovering" to pollers).
+        self._orphans: dict[str, int] = {}
+        #: cid -> terminal outcome dict, for placements lost to a
+        #: quarantined (crash-looping) slot.
+        self._tombstones: dict[str, dict] = {}
+        self._slots: dict[int, _SlotState] = {}
+        self._next_slot = 0
+        self._spawning_slot: int | None = None
         self._next_index = 0
         self._shedding = False
         self._idle_rounds = 0
@@ -361,9 +532,47 @@ class FleetServer:
 
     # -- worker pool ---------------------------------------------------------
 
-    def _spawn(self):
-        w = self._factory(self._next_index)
+    def _slot_wal_dir(self, slot: int) -> str | None:
+        """The journal directory slot ``slot``'s workers share across
+        respawns; None when the fleet runs journal-less."""
+        if not self.wal_root:
+            return None
+        return os.path.join(str(self.wal_root), f"slot{slot:03d}")
+
+    def _slot_cfg(self, slot: int) -> dict:
+        """Worker cfg with the slot's journal directory wired into the
+        scheduler kwargs (PartyWal mkdirs it on first append)."""
+        cfg = dict(self._cfg)
+        cfg["scheduler"] = dict(cfg["scheduler"])
+        wal = self._slot_wal_dir(slot)
+        if wal is not None:
+            cfg["scheduler"]["wal_dir"] = wal
+        return cfg
+
+    def _spawn(self, slot: int | None = None):
+        """Spawn a worker into ``slot`` (a fresh slot when None).
+        Caller holds ``self._lock`` (or is the constructor)."""
+        if slot is None:
+            slot = self._next_slot
+            self._next_slot += 1
+        st = self._slots.get(slot)
+        if st is None:
+            st = self._slots[slot] = _SlotState(slot)
+        self._spawning_slot = slot
+        try:
+            w = self._factory(self._next_index)
+        finally:
+            self._spawning_slot = None
         self._next_index += 1
+        try:
+            w.slot = slot
+        except AttributeError:
+            pass  # exotic fake without settable attrs: slot state still tracks it
+        st.worker = w
+        # journaling fleets always ask a fresh worker what it recovered:
+        # a replacement reports the slot journal's ceremonies, a brand
+        # new worker reports {} (and a restarted front door re-adopts)
+        st.needs_manifest = bool(self.wal_root)
         self._workers.append(w)
         self.metrics.set_gauge("fleet_workers", len(self._workers))
         return w
@@ -385,12 +594,183 @@ class FleetServer:
             )
         return out
 
+    # -- failover ------------------------------------------------------------
+
+    def _note_death_locked(self, w, now: float) -> None:
+        """Bookkeep one reaped worker: crash history, backoff, orphan
+        or evict its placements, quarantine on a crash loop.  Caller
+        holds ``self._lock``."""
+        slot = getattr(w, "slot", None)
+        st = self._slots.get(slot) if slot is not None else None
+        if st is None:
+            self._evict_placed([w])  # untracked (pre-slot fake): old behavior
+            return
+        st.worker = None
+        st.deaths = [d for d in st.deaths if now - d < self.respawn_window_s]
+        st.deaths.append(now)
+        d = len(st.deaths)
+        if d >= self.respawn_max:
+            st.quarantined = True
+            self.metrics.inc("fleet_worker_quarantined_total")
+            self._tombstone_slot(st, w)
+            return
+        # first death respawns immediately (a lone crash should not
+        # delay recovery); repeats back off exponentially under the cap
+        st.respawn_at = now + (
+            0.0 if d == 1 else min(
+                _RESPAWN_BACKOFF_CAP_S,
+                self.respawn_backoff_s * (2.0 ** (d - 2)),
+            )
+        )
+        if self.wal_root:
+            self._orphan_placed(w, st.slot)
+        else:
+            self._evict_placed([w])
+
+    def _orphan_placed(self, w, slot: int) -> None:
+        """Detach ``w``'s placements without dropping them: the slot
+        journal can resurrect them.  Caller holds ``self._lock``."""
+        for cid, e in self._placed.items():
+            if e[0] is w:
+                e[0] = None
+                self._orphans[cid] = slot
+
+    def _tombstone_slot(self, st: _SlotState, w=None) -> None:
+        """Terminal-fail every placement a quarantined slot held — the
+        typed outcome clients see instead of an eternal "recovering".
+        Caller holds ``self._lock``.  A sanctioned ``_placed`` remover
+        (lint DKG017)."""
+        err = (
+            f"FleetSlotQuarantined: slot {st.slot} died {len(st.deaths)}x "
+            f"within {self.respawn_window_s:g}s"
+        )
+        cids = [c for c, s in self._orphans.items() if s == st.slot]
+        if w is not None:
+            cids += [c for c, e in self._placed.items() if e[0] is w]
+        for cid in cids:
+            self._orphans.pop(cid, None)
+            self._placed.pop(cid, None)
+            self._tombstones[cid] = {
+                "ceremony_id": cid,
+                "status": "failed",
+                "error": err,
+            }
+
+    def _respawn_due_locked(self, now: float) -> list:
+        """Respawn dead slots whose backoff expired; retire dead slots
+        nobody needs.  Returns ``[(slot_state, worker), ...]`` spawned.
+        Caller holds ``self._lock``."""
+        spawned = []
+        if self._closing:
+            return spawned
+        orphan_slots = set(self._orphans.values())
+        for st in sorted(self._slots.values(), key=lambda s: s.slot):
+            if st.worker is not None or st.quarantined:
+                continue
+            alive = sum(1 for w in self._workers if w.alive())
+            if alive >= self.k_min and st.slot not in orphan_slots:
+                del self._slots[st.slot]  # spare capacity: retire the slot
+                continue
+            if now < st.respawn_at:
+                continue
+            spawned.append((st, self._spawn(slot=st.slot)))
+        return spawned
+
+    def _reap_and_respawn(self) -> list:
+        """Remove dead workers from the pool and respawn their slots
+        (backoff permitting).  Shared by the control loop and the data
+        plane's failure paths; safe to call from any thread."""
+        with self._lock:
+            now = time.monotonic()
+            for w in [w for w in self._workers if not w.alive()]:
+                self._workers.remove(w)
+                self.metrics.inc("fleet_worker_restarts_total")
+                self._note_death_locked(w, now)
+            spawned = self._respawn_due_locked(now)
+            self.metrics.set_gauge("fleet_workers", len(self._workers))
+        for st, w in spawned:
+            if self._fault_plan is not None:
+                # the storm's kill-during-recovery hook
+                try:
+                    self._fault_plan.on_respawn(self, st.slot, w)
+                except Exception:
+                    self.metrics.inc("fleet_control_errors_total")
+        return spawned
+
+    def _try_manifest(
+        self, st: _SlotState, w, timeout: float = _MANIFEST_TIMEOUT_S
+    ) -> bool:
+        """Ask a worker for its ceremony inventory and adopt it.  False
+        when the worker is still warming/busy (caller retries later;
+        the rid framing discards the eventual stale reply)."""
+        try:
+            reply = w.call(
+                "manifest", timeout=timeout, lock_timeout=_BUSY_LOCK_TIMEOUT_S
+            )
+        except WorkerUnavailable:
+            return False
+        if not reply.get("ok"):
+            return False
+        self._adopt_manifest(st, w, reply.get("ceremonies") or {})
+        return True
+
+    def _adopt_manifest(self, st: _SlotState, w, ceremonies: dict) -> None:
+        """Repopulate ``_placed`` from what a replacement worker
+        actually recovered.  Orphans of this slot present in the
+        manifest are re-placed under their ORIGINAL cid; orphans absent
+        from it (non-durable, or lost to journal corruption) are
+        reported lost.  A sanctioned ``_placed`` remover (DKG017)."""
+        with self._lock:
+            for cid in [c for c, s in self._orphans.items() if s == st.slot]:
+                del self._orphans[cid]
+                if cid in ceremonies:
+                    self._placed[cid] = [w, False]
+                    self.metrics.inc("fleet_placements_recovered_total")
+                else:
+                    self._placed.pop(cid, None)
+                    self.metrics.inc("fleet_placements_lost_total")
+            # ceremonies the worker knows that nobody placed (front door
+            # itself restarted over a populated journal root): adopt them
+            for cid in ceremonies:
+                if cid not in self._placed and cid not in self._tombstones:
+                    self._placed[cid] = [w, False]
+            st.needs_manifest = False
+
+    def _adopt_pending_manifests(self) -> None:
+        """Collect manifests from every live worker still owing one."""
+        with self._lock:
+            pend = [
+                (st, st.worker)
+                for st in self._slots.values()
+                if st.needs_manifest
+                and st.worker is not None
+                and st.worker.alive()
+            ]
+        for st, w in pend:
+            self._try_manifest(st, w)
+
+    def _try_adopt(self, cid: str, timeout: float) -> None:
+        """Data-plane nudge for one orphan: respawn its slot if due and
+        ask the replacement for its manifest — so a poll/result hitting
+        a recovering cid converges without waiting for a control tick."""
+        self._reap_and_respawn()
+        with self._lock:
+            slot = self._orphans.get(cid)
+            st = self._slots.get(slot) if slot is not None else None
+            w = st.worker if st is not None else None
+        if st is not None and w is not None and w.alive():
+            self._try_manifest(st, w, timeout=timeout)
+
     # -- data plane ----------------------------------------------------------
 
-    def _worker_for(self, curve: str, n: int, t: int):
+    def _worker_for(self, curve: str, n: int, t: int, exclude=None):
         b = buckets.bucket_for(n, t)
         with self._lock:
             ws = self._alive()
+            if exclude is not None and len(ws) > 1:
+                # submit failover: re-route around the worker that just
+                # failed — ring-next lands one step over in the same ring
+                ws = [w for w in ws if w is not exclude]
             if not ws:
                 raise errors.QueueFullError("fleet has no live workers")
             tag = hashlib.blake2b(
@@ -402,7 +782,9 @@ class FleetServer:
         """Route one ceremony request (JSON-able dict of
         CeremonyRequest fields) to its bucket's worker.  Raises
         QueueFullError on shed/full (the HTTP 503 path) and ValueError
-        on a malformed request."""
+        on a malformed request.  A routed worker dying mid-submit gets
+        ONE retry against the replacement or ring-next worker after a
+        short backoff (``fleet_submit_retries_total``) before the 503."""
         with self._lock:
             if self._shedding:
                 self.metrics.inc("fleet_shed_total")
@@ -413,12 +795,36 @@ class FleetServer:
             curve, n, t = req["curve"], int(req["n"]), int(req["t"])
         except (KeyError, TypeError, ValueError) as exc:
             raise ValueError(f"submit needs curve/n/t: {exc}") from exc
+        if req.get("durable"):
+            # fail fast at the front door with the scheduler's typed
+            # messages — not deep in a worker after queueing
+            if req.get("seed") is None:
+                raise ValueError(
+                    "durable ceremonies must be seeded: the journal "
+                    "replays the seed, not the coefficients"
+                )
+            if not self.wal_root and not self._cfg["scheduler"].get("wal_dir"):
+                raise ValueError(
+                    "durable ceremony submitted but the fleet has no "
+                    "journal root (DKG_TPU_FLEET_WAL_DIR / wal_root=)"
+                )
         w = self._worker_for(curve, n, t)
         try:
             reply = w.call("submit", req=dict(req), timeout=self.op_timeout_s)
         except WorkerUnavailable as exc:
             self.metrics.inc("fleet_worker_errors_total")
-            raise errors.QueueFullError(str(exc)) from exc
+            self.metrics.inc("fleet_submit_retries_total")
+            self._reap_and_respawn()
+            if self.submit_retry_backoff_s:
+                time.sleep(self.submit_retry_backoff_s)
+            w = self._worker_for(curve, n, t, exclude=w)
+            try:
+                reply = w.call(
+                    "submit", req=dict(req), timeout=self.op_timeout_s
+                )
+            except WorkerUnavailable as exc2:
+                self.metrics.inc("fleet_worker_errors_total")
+                raise errors.QueueFullError(str(exc2)) from exc2
         if not reply.get("ok"):
             if reply.get("error") == "queue_full":
                 self.metrics.inc("fleet_shed_total")
@@ -442,40 +848,138 @@ class FleetServer:
             del self._placed[cid]
 
     def poll(self, cid: str) -> str:
-        w = self._placed_worker(cid)
+        """Status for ``cid`` — including the failover statuses:
+        ``recovering`` while an orphan waits for its replacement
+        worker, ``failed`` (from the tombstone) after quarantine."""
+        with self._lock:
+            tomb = self._tombstones.get(cid)
+            if tomb is not None:
+                return tomb["status"]
+            orphan = cid in self._orphans
+            entry = self._placed.get(cid)
+        if orphan:
+            self._try_adopt(cid, timeout=0.2)
+            with self._lock:
+                tomb = self._tombstones.get(cid)
+                if tomb is not None:
+                    return tomb["status"]
+                if cid in self._orphans:
+                    return "recovering"
+                entry = self._placed.get(cid)
+        w = entry[0] if entry is not None else None
         if w is None or not w.alive():
+            self._reap_and_respawn()  # the death may orphan it right now
+            with self._lock:
+                if cid in self._orphans:
+                    return "recovering"
+                tomb = self._tombstones.get(cid)
+                if tomb is not None:
+                    return tomb["status"]
             return "unknown"
-        reply = w.call("poll", cid=cid, timeout=self.op_timeout_s)
+        try:
+            reply = w.call("poll", cid=cid, timeout=self.op_timeout_s)
+        except WorkerUnavailable:
+            self.metrics.inc("fleet_worker_errors_total")
+            self._reap_and_respawn()
+            with self._lock:
+                if cid in self._orphans:
+                    return "recovering"
+            return "unknown"
         return reply.get("status", "unknown") if reply.get("ok") else "unknown"
 
     def result(self, cid: str, timeout: float | None = None) -> dict:
-        w = self._placed_worker(cid)
-        if w is None:
-            raise KeyError(f"unknown ceremony {cid!r}")
+        """Block for ``cid``'s outcome.  Orphaned placements wait for
+        their replacement worker inside the same budget; a quarantined
+        slot's tombstone is returned as the typed terminal outcome."""
         # the scheduler wait rides IN the message; the pipe budget is
         # strictly larger, so a slow ceremony surfaces as the worker's
         # clean TimeoutError reply, never a parent-side pipe timeout
         budget = timeout if timeout is not None else self.op_timeout_s
-        reply = w.call("result", cid=cid, wait_s=budget, timeout=budget + 10.0)
-        if not reply.get("ok"):
-            detail = reply.get("detail") or reply.get("error")
-            if reply.get("error") == "TimeoutError":
-                raise TimeoutError(detail)
-            raise errors.ServiceError(detail)
-        with self._lock:
-            entry = self._placed.get(cid)
-            if entry is not None:
-                entry[1] = True
-        return reply["outcome"]
+        deadline = time.monotonic() + budget
+        first = True
+        while True:
+            with self._lock:
+                tomb = self._tombstones.get(cid)
+                if tomb is not None:
+                    return dict(tomb)
+                orphan = cid in self._orphans
+                entry = self._placed.get(cid)
+            if entry is None and not orphan:
+                raise KeyError(f"unknown ceremony {cid!r}")
+            w = entry[0] if entry is not None else None
+            if orphan or w is None or not w.alive():
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"ceremony {cid} still recovering after {budget}s"
+                    )
+                if orphan:
+                    self._try_adopt(
+                        cid, timeout=min(2.0, max(0.1, remaining))
+                    )
+                    time.sleep(0.05)
+                else:
+                    self._reap_and_respawn()
+                continue
+            wait_s = budget if first else max(
+                0.1, deadline - time.monotonic()
+            )
+            first = False
+            try:
+                reply = w.call(
+                    "result", cid=cid, wait_s=wait_s, timeout=wait_s + 10.0
+                )
+            except WorkerUnavailable as exc:
+                self.metrics.inc("fleet_worker_errors_total")
+                self._reap_and_respawn()
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"ceremony {cid}: worker lost mid-result ({exc})"
+                    ) from exc
+                continue
+            if not reply.get("ok"):
+                detail = reply.get("detail") or reply.get("error")
+                if reply.get("error") == "TimeoutError":
+                    raise TimeoutError(detail)
+                raise errors.ServiceError(detail)
+            with self._lock:
+                entry = self._placed.get(cid)
+                if entry is not None:
+                    entry[1] = True
+            return reply["outcome"]
 
     def sign(self, cid: str, msgs: list[bytes], **kw) -> list[bytes]:
+        with self._lock:
+            tomb = self._tombstones.get(cid)
+            orphan = cid in self._orphans
+        if tomb is not None:
+            raise errors.FleetSlotQuarantined(tomb["error"])
+        if orphan:
+            self._try_adopt(cid, timeout=2.0)
+            with self._lock:
+                tomb = self._tombstones.get(cid)
+                orphan = cid in self._orphans
+            if tomb is not None:
+                raise errors.FleetSlotQuarantined(tomb["error"])
+            if orphan:
+                raise errors.TransientEngineError(
+                    f"ceremony {cid} is recovering on a replacement "
+                    f"worker; retry"
+                )
         w = self._placed_worker(cid)
         if w is None:
             raise KeyError(f"unknown ceremony {cid!r}")
-        reply = w.call(
-            "sign", cid=cid, msgs=[m.hex() for m in msgs],
-            timeout=self.op_timeout_s, **kw,
-        )
+        try:
+            reply = w.call(
+                "sign", cid=cid, msgs=[m.hex() for m in msgs],
+                timeout=self.op_timeout_s, **kw,
+            )
+        except WorkerUnavailable as exc:
+            self.metrics.inc("fleet_worker_errors_total")
+            self._reap_and_respawn()
+            raise errors.TransientEngineError(
+                f"worker lost mid-sign for {cid}; retry after recovery: {exc}"
+            ) from exc
         if not reply.get("ok"):
             raise errors.ServiceError(reply.get("detail") or reply.get("error"))
         return [bytes.fromhex(s) for s in reply["sigs"]]
@@ -542,6 +1046,26 @@ class FleetServer:
 
     def describe(self) -> dict:
         with self._lock:
+            now = time.monotonic()
+            slots = []
+            for st in sorted(self._slots.values(), key=lambda s: s.slot):
+                live = st.worker is not None and st.worker.alive()
+                slots.append({
+                    "slot": st.slot,
+                    "state": (
+                        "quarantined" if st.quarantined
+                        else "live" if live
+                        else "down"
+                    ),
+                    "deaths": len(st.deaths),
+                    "respawn_in_s": (
+                        max(0.0, st.respawn_at - now)
+                        if not live and not st.quarantined
+                        else 0.0
+                    ),
+                    "worker": st.worker.index if st.worker is not None else None,
+                    "wal_dir": self._slot_wal_dir(st.slot),
+                })
             return {
                 "workers": len(self._workers),
                 "alive": len(self._alive()),
@@ -550,25 +1074,25 @@ class FleetServer:
                 "shedding": self._shedding,
                 "warmup_s": [w.warmup_s for w in self._workers],
                 "placed": len(self._placed),
+                "slots": slots,
+                "orphans": len(self._orphans),
+                "tombstones": len(self._tombstones),
+                "quarantined": sum(
+                    1 for st in self._slots.values() if st.quarantined
+                ),
             }
 
     def _control_once(self) -> dict:
         """One SLO-driven control decision; called by the loop thread
         and directly by tests.  Returns the decision record."""
+        # reap dead workers and respawn their slots under per-slot
+        # backoff (never the old unconditional toward-k_min hot loop: a
+        # worker dying at boot backs off and eventually quarantines
+        # instead of spawn/reap spinning forever), then collect what
+        # the replacements recovered from their slot journals
+        self._reap_and_respawn()
+        self._adopt_pending_manifests()
         with self._lock:
-            ws = list(self._workers)
-            # reap workers that died (crash, OOM-kill): routing already
-            # skips them, this trims the pool, frees the pipe, and
-            # forgets placements nobody can serve anymore
-            dead = [w for w in ws if not w.alive()]
-            for w in dead:
-                self._workers.remove(w)
-                self.metrics.inc("fleet_worker_restarts_total")
-            self._evict_placed(dead)
-            # keep the pool at the floor: a crashed worker is replaced
-            # even in a healthy window
-            while len(self._workers) < self.k_min and not self._closing:
-                self._spawn()
             ws = list(self._workers)
         reports, healths = [], []
         for w in ws:
@@ -630,6 +1154,7 @@ class FleetServer:
                 if victim is not None:
                     self._workers.remove(victim)
                     self._evict_placed([victim])
+                    self._slots.pop(getattr(victim, "slot", None), None)
                     decision = "down"
                     self._idle_rounds = 0
                     self.metrics.inc("fleet_scale_total", direction="down")
@@ -725,5 +1250,8 @@ class FleetServer:
             ws = list(self._workers)
             self._workers.clear()
             self._placed.clear()
+            self._orphans.clear()
+            self._tombstones.clear()
+            self._slots.clear()
         for w in ws:
             w.stop(drain=drain)

@@ -504,6 +504,17 @@ class CeremonyScheduler:
         with self._cond:
             return self._status.get(cid, "unknown")
 
+    def manifest(self) -> dict[str, str]:
+        """Every ceremony id this scheduler knows, with its current
+        status — the post-recovery inventory a fleet parent uses to
+        repopulate its placement map after respawning a worker from a
+        slot journal (service/fleet.py's ``manifest`` pipe op).  Covers
+        queued/running work and terminal outcomes alike; an id absent
+        here after a journal recovery was genuinely never accepted (or
+        was non-durable) and is reported lost, not resurrected."""
+        with self._cond:
+            return dict(self._status)
+
     def result(self, cid: str, timeout: float | None = None) -> CeremonyOutcome:
         """Block until ``cid`` reaches a terminal status and return its
         outcome (TimeoutError on timeout, KeyError for unknown ids)."""

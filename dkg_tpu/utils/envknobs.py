@@ -63,6 +63,18 @@ DKG_TPU_FLEET_MIN / DKG_TPU_FLEET_MAX (autoscale floor/ceiling) /
 DKG_TPU_FLEET_CONTROL_S (control-loop period; unset disables the
 loop) / DKG_TPU_FLEET_HTTP_PORT (front-door port; 0 binds an
 ephemeral port, unset keeps the fleet python-API only) via
+service.fleet,
+DKG_TPU_FLEET_WAL_DIR (per-slot fleet journal root: slot NNN's workers
+journal into <root>/slotNNN and a replacement worker recovers from it;
+unset disables worker failover — reaped workers' placements are
+evicted) / DKG_TPU_FLEET_RESPAWN_BACKOFF_S (backoff before a slot's
+SECOND respawn, doubling per further death, capped; the first respawn
+is immediate; default 0.5) / DKG_TPU_FLEET_RESPAWN_MAX (deaths within
+the window before a slot is quarantined instead of respawned, default
+3 — the fleet mirror of DKG_TPU_SERVICE_MAX_REPLAYS) /
+DKG_TPU_FLEET_RESPAWN_WINDOW_S (rolling crash-loop window, default
+60) / DKG_TPU_FLEET_SUBMIT_RETRY_S (pause before submit's one retry
+against the replacement or ring-next worker, default 0.05) via
 service.fleet).
 
 An EMPTY value is everywhere treated as unset: ``DKG_TPU_X= cmd`` is

@@ -142,6 +142,14 @@ linter), so the committed baseline stays clean between CI runs:
         worker then re-initializes.  Executables live in workers
         (service/engine.py dispatch seams, service/aot.py store); the
         parent routes bytes
+* DKG017  (dkg_tpu/service/fleet.py only) ``_placed`` entries removed
+        outside the sanctioned eviction/manifest helpers
+        (``_evict_placed`` / ``_adopt_manifest`` / ``_tombstone_slot``
+        / ``close``): a ``del`` / ``.pop`` / ``.clear`` anywhere else
+        is a silent placement drop — exactly the bug the failover work
+        removed, where a reaped worker's accepted ceremonies vanished
+        (poll -> "unknown") instead of becoming orphans the slot
+        journal can resurrect or tombstones that explain themselves
 
 Exit 0 = clean.  Run: ``python scripts/lint_lite.py`` (from repo root).
 Also executed by tests/test_import_hygiene.py so the default test tier
@@ -270,6 +278,19 @@ _DKG011_EMITTERS = {"inc", "observe", "set_gauge"}
 # Metric names exempt from the DKG011 docs requirement (test-only or
 # deliberately undocumented names; currently none).
 _DKG011_UNDOCUMENTED_OK: set[str] = set()
+
+# The only functions allowed to remove FleetServer._placed entries
+# (DKG017): reap-eviction, manifest adoption, quarantine tombstoning,
+# and shutdown.  Everything else may only read or add placements.
+_PLACED_MUTATORS = {
+    "_evict_placed",
+    "_adopt_manifest",
+    "_tombstone_slot",
+    "close",
+}
+
+# Mapping methods that remove entries (DKG017's call spelling).
+_PLACED_REMOVERS = {"pop", "clear", "popitem"}
 
 # Raw socket I/O methods banned in dkg_tpu/net/ outside the counted
 # wire helpers (DKG012): bytes that bypass them are invisible to
@@ -604,7 +625,53 @@ class _Checker(ast.NodeVisitor):
                 )
         return name if raw_write else ""
 
+    @staticmethod
+    def _is_self_placed(node: ast.AST) -> bool:
+        """True for the ``self._placed`` attribute expression."""
+        return (
+            isinstance(node, ast.Attribute)
+            and node.attr == "_placed"
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        )
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        # DKG017 (del spelling): ``del self._placed[cid]`` outside the
+        # sanctioned placement-removal helpers is a silent drop.
+        if self._fleet_module and not (set(self._func_stack) & _PLACED_MUTATORS):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript) and self._is_self_placed(
+                    tgt.value
+                ):
+                    self._add(
+                        node,
+                        "DKG017",
+                        "del self._placed[...] outside the sanctioned "
+                        "helpers (_evict_placed/_adopt_manifest/"
+                        "_tombstone_slot/close) — placements leave the "
+                        "map as orphans, tombstones or evictions, never "
+                        "silently",
+                    )
+        self.generic_visit(node)
+
     def visit_Call(self, node: ast.Call) -> None:
+        # DKG017 (method spelling): self._placed.pop()/.clear() outside
+        # the sanctioned placement-removal helpers.
+        if self._fleet_module and not (set(self._func_stack) & _PLACED_MUTATORS):
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in _PLACED_REMOVERS
+                and self._is_self_placed(func.value)
+            ):
+                self._add(
+                    node,
+                    "DKG017",
+                    f"self._placed.{func.attr}() outside the sanctioned "
+                    "helpers (_evict_placed/_adopt_manifest/"
+                    "_tombstone_slot/close) — placements leave the map "
+                    "as orphans, tombstones or evictions, never silently",
+                )
         # DKG001: net-layer decodes must route through the quarantine —
         # a raw decode_phase* call lets Byzantine bytes raise through
         # run_party (malformed messages must disqualify the sender).

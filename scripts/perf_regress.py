@@ -87,6 +87,18 @@ without a typed ``PoisonedRequest`` outcome, blame accuracy < 1.0
 a signing blame pass count above the ceil(log2 grid)+1-per-bad-cell
 bound.  No storm rounds on disk skips with a note.
 
+The fleet chaos storm: ``FLEETSTORM_r{NN}.json`` rounds
+(scripts/fleet_storm.py) gate FLOORS on the newest round the same way —
+process-level failover is an invariant.  FAIL when the newest round
+accepted fewer than 100 seeded ceremonies, LOST any accepted ceremony
+(no terminal outcome under its original cid), injected fewer than one
+worker kill mid-ceremony plus one mid-recovery, skipped the pipe
+garbage or slot-journal tail corruption legs, recovered any master
+that was not bit-identical to the fault-free single-process reference,
+or quarantined a different number of crash-looping slots than the
+fault plan scheduled.  No fleet-storm rounds on disk skips with a
+note.
+
 Run: ``python scripts/perf_regress.py [--threshold 0.2] [dir]``.
 """
 
@@ -104,6 +116,7 @@ _FLEET_PAT = re.compile(r"FLEET_r(\d+)\.json$")
 _EPOCH_PAT = re.compile(r"EPOCH_r(\d+)\.json$")
 _SIGN_PAT = re.compile(r"SIGN_r(\d+)\.json$")
 _SVCSTORM_PAT = re.compile(r"SVCSTORM_r(\d+)\.json$")
+_FLEETSTORM_PAT = re.compile(r"FLEETSTORM_r(\d+)\.json$")
 _NORTHSTAR_PAT = re.compile(r"NORTHSTAR_r(\d+)\.json$")
 
 
@@ -151,6 +164,7 @@ def main(argv: list[str] | None = None) -> int:
         or epoch_gate(root, args.threshold)
         or sign_gate(root, args.threshold)
         or svcstorm_gate(root)
+        or fleetstorm_gate(root)
         or northstar_gate(root, args.threshold)
         or _slo_gate(root)
     )
@@ -773,6 +787,102 @@ def svcstorm_gate(root: pathlib.Path) -> int:
             f"perf_regress: storm r{new_n} has no sign leg — convoy "
             "floors only"
         )
+    return bad
+
+
+def _load_fleetstorm_rounds(root: pathlib.Path) -> list[tuple[int, dict]]:
+    """(round number, fleet-storm report) for every usable round,
+    ascending — usable means the storm accepted a positive number of
+    seeded ceremonies (an infra-dead round skips rather than blocks)."""
+    out: list[tuple[int, dict]] = []
+    for path in sorted(root.glob("FLEETSTORM_r*.json")):
+        m = _FLEETSTORM_PAT.search(path.name)
+        if not m:
+            continue
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError):
+            continue
+        cer = (doc.get("ceremonies") or {}) if isinstance(doc, dict) else {}
+        reqs = cer.get("requests")
+        if not isinstance(reqs, int) or reqs <= 0:
+            continue
+        out.append((int(m.group(1)), doc))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+def fleetstorm_gate(root: pathlib.Path) -> int:
+    """Floor-check the NEWEST fleet-storm round (scripts/fleet_storm.py)
+    in the SVCSTORM style: worker-failover resilience is an invariant,
+    not a rate.  Hard floors — >=100 accepted seeded ceremonies under
+    >=2 worker kills (one mid-ceremony, one mid-recovery) plus pipe
+    garbage and journal tail corruption; ZERO accepted ceremonies lost;
+    every recovered master bit-identical to the fault-free reference;
+    crash-loop quarantine counts exact."""
+    rounds = _load_fleetstorm_rounds(root)
+    if not rounds:
+        print(f"perf_regress: no usable fleet-storm round in {root} — skipping")
+        return 0
+    new_n, doc = rounds[-1]
+    cer = doc.get("ceremonies") or {}
+    faults = doc.get("faults") or {}
+    quarantine = doc.get("quarantine") or {}
+    bad = 0
+
+    def floor(label: str, ok: bool, detail: str) -> None:
+        nonlocal bad
+        line = f"perf_regress: fleetstorm r{new_n} {label}: {detail}"
+        if ok:
+            print(line)
+        else:
+            print(f"{line} — RESILIENCE FLOOR VIOLATED", file=sys.stderr)
+            bad = 1
+
+    reqs = cer.get("requests")
+    floor(
+        "workload",
+        isinstance(reqs, int) and reqs >= 100,
+        f"{reqs!r} accepted seeded ceremonies (need >= 100)",
+    )
+    lost = cer.get("lost")
+    floor("zero loss", lost == 0, f"{lost!r} accepted ceremonies lost")
+    mid_c = faults.get("kills_mid_ceremony")
+    mid_r = faults.get("kills_mid_recovery")
+    floor(
+        "worker kills",
+        isinstance(mid_c, int)
+        and isinstance(mid_r, int)
+        and mid_c >= 1
+        and mid_r >= 1,
+        f"{mid_c!r} mid-ceremony + {mid_r!r} mid-recovery (need >= 1 each)",
+    )
+    garbage = faults.get("pipe_garbage")
+    floor(
+        "pipe garbage",
+        isinstance(garbage, int) and garbage >= 1,
+        f"{garbage!r} garbled frames injected",
+    )
+    torn = faults.get("journal_corrupted")
+    floor(
+        "journal corruption",
+        isinstance(torn, int) and torn >= 1,
+        f"{torn!r} slot-journal tails corrupted",
+    )
+    rec = cer.get("recovered") or {}
+    rcount, rident = rec.get("count"), rec.get("bit_identical")
+    floor(
+        "recovered bit-identity",
+        isinstance(rcount, int) and rcount >= 1 and rident == rcount,
+        f"{rident!r}/{rcount!r} recovered masters match the fault-free leg",
+    )
+    q_exp, q_obs = quarantine.get("expected"), quarantine.get("observed")
+    floor(
+        "quarantine count",
+        isinstance(q_exp, int) and q_obs == q_exp,
+        f"{q_obs!r}/{q_exp!r} slots quarantined",
+    )
+    floor("overall", doc.get("ok") is True, f"ok={doc.get('ok')!r}")
     return bad
 
 

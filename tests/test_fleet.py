@@ -40,22 +40,31 @@ class FakeWorker:
         self.queue_full = False
         self.result_timeout = False
         self.busy = False
+        self.unavailable = False  # every op raises WorkerUnavailable
         self.slo_ok = True
         self.burn = 0.0
         self.queue_depth = 0
+        self.manifest_ceremonies = {}  # what a "recovered" worker reports
         self._alive = True
         self._serial = 0
 
     def alive(self):
         return self._alive
 
+    def kill(self):
+        self._alive = False
+
     def stop(self, drain=True, timeout=None):
         self.stopped = drain
         self._alive = False
 
     def call(self, op, timeout=None, lock_timeout=None, **kw):
+        if self.unavailable:
+            raise WorkerUnavailable(f"worker {self.index} unavailable")
         if self.busy and lock_timeout is not None:
             raise WorkerBusy(f"worker {self.index} busy")
+        if op == "manifest":
+            return {"ok": True, "ceremonies": dict(self.manifest_ceremonies)}
         if op == "submit":
             if self.queue_full:
                 return {"ok": False, "error": "queue_full", "detail": "wal full"}
@@ -450,6 +459,241 @@ def test_reaped_worker_placements_are_evicted(fleet_factory):
     assert cid not in fleet._placed
     assert fleet.describe()["placed"] == 0
     assert fleet.poll(cid) == "unknown"
+
+
+def test_slot_wal_dirs_are_per_slot(fleet_factory, tmp_path):
+    fleet, _ = fleet_factory(procs=2, k_min=2, wal_root=str(tmp_path))
+    d0, d1 = fleet._slot_wal_dir(0), fleet._slot_wal_dir(1)
+    assert d0.endswith("slot000") and d1.endswith("slot001") and d0 != d1
+    assert fleet._slot_cfg(0)["scheduler"]["wal_dir"] == d0
+    assert fleet._slot_cfg(1)["scheduler"]["wal_dir"] == d1
+    # journal-less fleets wire no wal_dir at all
+    bare, _ = fleet_factory(procs=1)
+    assert bare._slot_wal_dir(0) is None
+    assert "wal_dir" not in bare._slot_cfg(0)["scheduler"]
+
+
+def _manifest_factory(recovered, workers, warming=False):
+    """Workers whose manifest op reports the shared ``recovered`` dict
+    (mutated by the test after the cid exists); replacements can boot
+    "warming" (unavailable until the test flips them)."""
+
+    def factory(idx):
+        w = FakeWorker(idx)
+        w.manifest_ceremonies = recovered
+        if warming and workers:
+            w.unavailable = True
+        workers.append(w)
+        return w
+
+    return factory
+
+
+def test_slot_journal_handoff_repopulates_placed(fleet_factory, tmp_path):
+    """A dead worker's placements ride the slot journal to the
+    replacement: the manifest re-places them under the ORIGINAL cid."""
+    recovered, workers = {}, []
+    fleet, _ = fleet_factory(
+        procs=1, k_min=1, k_max=1, wal_root=str(tmp_path),
+        worker_factory=_manifest_factory(recovered, workers),
+    )
+    cid = fleet.submit(_req())
+    # what the replacement will report it recovered from the journal
+    # (plus one ceremony nobody here placed — a restarted front door
+    # adopts those too instead of stranding them)
+    recovered.update({cid: "queued", "ghost-cid": "done"})
+    workers[0].kill()
+    fleet._control_once()  # reap + respawn + manifest adoption
+    assert len(fleet._workers) == 1 and fleet._workers[0] is workers[1]
+    assert fleet._placed[cid][0] is workers[1]
+    assert "ghost-cid" in fleet._placed
+    assert cid not in fleet._orphans
+    assert fleet.poll(cid) == "done"  # FakeWorker polls answer done
+    snap = fleet.metrics.snapshot()["counters"]
+    assert snap["fleet_placements_recovered_total"] == 1
+    assert "fleet_placements_lost_total" not in snap
+
+
+def test_orphan_is_recovering_until_manifest_then_lost_if_absent(
+    fleet_factory, tmp_path
+):
+    """While the replacement warms, pollers see ``recovering``; a cid
+    the manifest does not contain (non-durable work) is reported lost,
+    never resurrected under a guessed status."""
+    recovered, workers = {}, []
+    fleet, _ = fleet_factory(
+        procs=1, k_min=1, k_max=1, wal_root=str(tmp_path),
+        worker_factory=_manifest_factory(recovered, workers, warming=True),
+    )
+    cid = fleet.submit(_req())
+    workers[0].kill()
+    # the replacement spawns but answers nothing yet (still warming)
+    fleet._control_once()
+    assert fleet.poll(cid) == "recovering"
+    assert cid in fleet._orphans and fleet._placed[cid][0] is None
+    # replacement comes up with an EMPTY journal recovery
+    workers[1].unavailable = False
+    fleet._control_once()
+    assert cid not in fleet._placed and cid not in fleet._orphans
+    assert fleet.poll(cid) == "unknown"
+    snap = fleet.metrics.snapshot()["counters"]
+    assert snap["fleet_placements_lost_total"] == 1
+
+
+def test_crash_loop_quarantines_slot_with_typed_outcome(
+    fleet_factory, tmp_path
+):
+    """A slot dying respawn_max times inside the window stops being
+    respawned; its placements fail with FleetSlotQuarantined instead
+    of recovering forever."""
+    recovered, workers = {}, []
+    fleet, _ = fleet_factory(
+        procs=1, k_min=1, k_max=1, wal_root=str(tmp_path),
+        respawn_max=2, respawn_backoff_s=0.0,
+        worker_factory=_manifest_factory(recovered, workers),
+    )
+    cid = fleet.submit(_req())
+    recovered[cid] = "queued"
+    workers[0].kill()
+    fleet._control_once()  # death 1: respawn + adopt onto workers[1]
+    assert fleet._placed[cid][0] is workers[1]
+    workers[1].kill()
+    fleet._control_once()  # death 2 == respawn_max: quarantine
+    snap = fleet.metrics.snapshot()["counters"]
+    assert snap["fleet_worker_quarantined_total"] == 1
+    d = fleet.describe()
+    assert d["quarantined"] == 1
+    assert d["slots"][0]["state"] == "quarantined"
+    assert fleet.poll(cid) == "failed"
+    out = fleet.result(cid)
+    assert out["status"] == "failed"
+    assert "FleetSlotQuarantined" in out["error"]
+    with pytest.raises(errors.FleetSlotQuarantined):
+        fleet.sign(cid, [b"m"])
+    # no backfill: the pool stays down (operator's call), no hot loop
+    made = len(workers)
+    for _ in range(3):
+        fleet._control_once()
+    assert len(workers) == made and len(fleet._workers) == 0
+
+
+def test_boot_dying_worker_backs_off_instead_of_hot_looping(fleet_factory):
+    """The satellite bugfix: a worker dying at boot used to respawn
+    unconditionally every control tick.  Now the second respawn waits
+    out the backoff — repeated ticks spawn nothing meanwhile."""
+    fleet, workers = fleet_factory(
+        procs=1, k_min=1, k_max=1, respawn_backoff_s=60.0, respawn_max=5,
+    )
+    workers[0].kill()
+    fleet._control_once()  # death 1: immediate replacement
+    assert len(workers) == 2
+    workers[1].kill()
+    for _ in range(5):
+        fleet._control_once()  # death 2: backoff holds ~60s
+    assert len(workers) == 2  # no hot loop
+    assert len(fleet._workers) == 0
+    d = fleet.describe()["slots"][0]
+    # the 60s knob clips at the 30s cap; either way ticks must not spawn
+    assert d["state"] == "down" and d["respawn_in_s"] > 25.0
+    snap = fleet.metrics.snapshot()["counters"]
+    assert snap["fleet_worker_restarts_total"] == 2
+
+
+def test_submit_retries_once_against_ring_next_worker(fleet_factory):
+    fleet, workers = fleet_factory(
+        procs=2, k_min=2, k_max=2, submit_retry_backoff_s=0.0
+    )
+    routed = fleet._worker_for("ristretto255", 8, 2)
+    other = next(w for w in workers if w is not routed)
+    routed.unavailable = True
+    cid = fleet.submit(_req())
+    assert any(c == cid for c, _ in other.submitted)
+    assert fleet._placed[cid][0] is other
+    snap = fleet.metrics.snapshot()["counters"]
+    assert snap["fleet_submit_retries_total"] == 1
+
+    # a single dead-end worker still sheds after the one retry
+    lone, lone_workers = fleet_factory(
+        procs=1, k_min=1, k_max=1, submit_retry_backoff_s=0.0
+    )
+    for w in lone_workers:
+        if w in lone._workers:
+            w.unavailable = True
+    with pytest.raises(errors.QueueFullError):
+        lone.submit(_req())
+    assert (
+        lone.metrics.snapshot()["counters"]["fleet_submit_retries_total"] == 1
+    )
+
+
+def test_unseeded_durable_submit_fails_fast_at_front_door(fleet_factory):
+    fleet, workers = fleet_factory(
+        procs=1, k_min=1, k_max=1, http_port=0, wal_root=None
+    )
+    with pytest.raises(ValueError, match="must be seeded"):
+        fleet.submit({"curve": "ristretto255", "n": 8, "t": 2, "durable": True})
+    with pytest.raises(ValueError, match="journal root"):
+        fleet.submit({**_req(), "durable": True})  # seeded but no wal_root
+    assert not workers[0].submitted  # neither reached a worker
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{fleet.port}/submit",
+        data=json.dumps(
+            {"curve": "ristretto255", "n": 8, "t": 2, "durable": True}
+        ).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 400
+    assert "seeded" in json.loads(ei.value.read())["detail"]
+
+
+@pytest.mark.slow
+def test_real_worker_kill_recovers_original_cid_bit_identical(tmp_path):
+    """The tentpole, end to end with spawned processes: SIGKILL the
+    worker mid-ceremony; the replacement boots from the slot journal
+    and the ORIGINAL cid's master comes back bit-identical to the
+    undisturbed single-process reference."""
+    import time as _time
+
+    from dkg_tpu.service import engine as engine_mod
+
+    fleet = FleetServer(
+        procs=1, k_min=1, k_max=1, control_interval_s=None,
+        wal_root=str(tmp_path / "fleetwal"),
+        scheduler_kwargs=dict(concurrency=1, queue_depth=8, batch_max=1),
+        metrics=MetricsRegistry(),
+    )
+    try:
+        assert fleet.wait_ready(600.0)[0] is not None
+        req = dict(
+            curve="ristretto255", n=16, t=5, seed=20251234, durable=True
+        )
+        cid = fleet.submit(req)
+        fleet._placed_worker(cid).kill()  # mid-ceremony, queue and all
+        deadline = _time.monotonic() + 600.0
+        out = None
+        while _time.monotonic() < deadline:
+            fleet._control_once()
+            status = fleet.poll(cid)
+            if status in ("done", "failed", "poisoned", "expired"):
+                out = fleet.result(cid, timeout=60.0)
+                break
+            _time.sleep(0.5)
+        assert out is not None, "recovered ceremony never reached terminal"
+        assert out["status"] == "done" and out["ceremony_id"] == cid
+        ref = engine_mod.run_single_reference(
+            engine_mod.CeremonyRequest(
+                curve="ristretto255", n=16, t=5, seed=20251234
+            )
+        )
+        assert out["master"] == ref.hex()
+        snap = fleet.metrics.snapshot()["counters"]
+        assert snap["fleet_placements_recovered_total"] >= 1
+    finally:
+        fleet.close(drain=False)
 
 
 def test_busy_worker_is_alive_in_health_and_skipped_by_control(fleet_factory):

@@ -255,6 +255,52 @@ def test_lint_dkg010_bans_silent_swallows_and_bare_runtimeerror():
     assert codes_for("tests/test_x.py") == []
 
 
+def test_lint_dkg017_bans_placement_drops_outside_helpers():
+    """DKG017: fleet.py may not remove ``_placed`` entries outside the
+    sanctioned eviction/manifest helpers — a del/pop/clear anywhere
+    else is a silent placement drop the failover machinery exists to
+    prevent."""
+    import ast
+    import pathlib
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "scripts"))
+    try:
+        import lint_lite
+    finally:
+        sys.path.pop(0)
+
+    src = (
+        "class F:\n"
+        "    def rogue(self, cid):\n"
+        "        del self._placed[cid]\n"
+        "        self._placed.pop(cid, None)\n"
+        "        self._placed.clear()\n"
+        "        self._placed[cid] = [None, False]\n"  # adding: fine
+        "        x = self._placed.get(cid)\n"  # reading: fine
+        "    def _evict_placed(self, ws):\n"
+        "        del self._placed['a']\n"  # sanctioned helper
+        "    def _adopt_manifest(self, st, w, m):\n"
+        "        self._placed.pop('a', None)\n"  # sanctioned helper
+        "    def close(self):\n"
+        "        self._placed.clear()\n"  # sanctioned helper
+    )
+    tree = ast.parse(src)
+
+    def codes_for(path):
+        return [
+            c
+            for _, c, _ in lint_lite._Checker(
+                pathlib.Path(path), tree, src
+            ).finish()
+            if c == "DKG017"
+        ]
+
+    assert len(codes_for("dkg_tpu/service/fleet.py")) == 3
+    # the rule is fleet-scoped: the same source elsewhere is clean
+    assert codes_for("dkg_tpu/service/scheduler.py") == []
+    assert codes_for("dkg_tpu/dkg/elsewhere.py") == []
+
+
 def test_hostmesh_import_is_lightweight():
     # The driver image's sitecustomize preloads jax itself, so "jax not
     # in sys.modules" is unattainable; assert the real invariants: no
